@@ -1,0 +1,38 @@
+"""pilosa_tpu — a TPU-native distributed bitmap analytics engine.
+
+A ground-up rebuild of the capabilities of FeatureBase/Pilosa (reference:
+/root/reference, Go) designed for TPU hardware:
+
+- Records are columns; attribute values are rows of per-shard bitmaps
+  (reference: fragment.go:84, shardwidth/helper.go:14).
+- Shards are **dense bitmap planes in HBM**: ``uint32[rows, 2^20/32]`` tiles,
+  not adaptive roaring containers (reference: roaring/roaring.go:232). XLA
+  loves dense, statically-shaped tensors; compression lives at rest on host.
+- Queries (PQL boolean algebra + popcount + rank/aggregate; reference:
+  executor.go) lower to XLA bitwise ops, ``lax.population_count``, bit-plane
+  compare circuits and MXU matmuls.
+- Distribution is shard→device placement on a ``jax.sharding.Mesh`` with
+  ``psum``/``all_gather`` collectives over ICI/DCN, replacing the reference's
+  HTTP scatter-gather (internal_client.go) and jump-hash shard→node placement
+  (disco/snapshot.go:69).
+
+Layout:
+    ops/       L0 kernels: bitmap algebra, popcount, BSI, top-k, group-by
+    core/      data model: holder/index/field/view/fragment, time quantums,
+               key translation, ID allocation
+    pql/       PQL parser + executor (map/reduce over shards)
+    parallel/  device-mesh placement + collective reduces
+    storage/   host-side shard store, snapshots, roaring wire codec
+    server/    HTTP API surface
+"""
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, WORDS_PER_SHARD
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SHARD_WIDTH",
+    "SHARD_WIDTH_EXP",
+    "WORDS_PER_SHARD",
+    "__version__",
+]

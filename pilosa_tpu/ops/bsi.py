@@ -1,0 +1,308 @@
+"""Bit-sliced index (BSI) kernels.
+
+Integer/decimal/timestamp values are stored as bit planes over the columns
+of a shard (reference: fragment.go:62-66): plane 0 = "exists", plane 1 =
+sign, planes 2.. = magnitude bits LSB-first; values are sign-magnitude
+relative to a per-field base. Range predicates are bitwise compare circuits
+over the planes (reference: fragment.go:963-1305 rangeOp*), Sum is a
+per-plane popcount weighted by 2^k (reference: fragment.go:724), Min/Max
+walk planes MSB->LSB narrowing a candidate set (reference:
+fragment.go:754-857).
+
+TPU-first design notes:
+- A BSI fragment is ``uint32[2+depth, W]`` — the whole compare circuit is a
+  handful of fused elementwise ops per plane; XLA keeps everything in
+  registers/VMEM and the HBM traffic is one stream over the planes.
+- Predicate constants are passed as *bit vectors* (host-prepared bool[depth])
+  so kernels are traced once per (shape, op) and never recompile per value.
+- Exact 64-bit arithmetic (sums, values) is assembled host-side from int32
+  per-plane popcounts — device code stays int32 and x64-free.
+
+Plane stack layout used throughout: ``planes[0]`` exists, ``planes[1]``
+sign, ``planes[2 + k]`` magnitude bit k.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.ops.bitmap import _popcount_i32 as _pc
+
+EXISTS = 0
+SIGN = 1
+OFFSET = 2  # first magnitude plane (reference: fragment.go:66 bsiOffsetBit)
+
+# Comparison ops (reference: pql/ast.go condition tokens; executor rangeOp
+# dispatch fragment.go:937).
+EQ, NE, LT, LE, GT, GE, BETWEEN = "eq", "ne", "lt", "le", "gt", "ge", "between"
+
+
+def _any(plane):
+    return jnp.sum(_pc(plane)) > 0
+
+
+def value_bits(value: int, depth: int):
+    """Host-side: split |value| into (bool[depth] LSB-first, overflow, neg).
+
+    ``overflow`` means |value| >= 2^depth i.e. beyond representable
+    magnitude; the compare circuits use it to short-circuit exactly like the
+    reference's bit-depth clamp (fragment.go:963 rangeOp value clamping).
+    """
+    neg = value < 0
+    mag = -value if neg else value
+    bits = np.array([(mag >> k) & 1 for k in range(depth)], dtype=bool)
+    overflow = (mag >> depth) != 0
+    return bits, overflow, neg
+
+
+def _mag_compare(mag_planes, candidates, cbits, coverflow):
+    """Unsigned magnitude compare of candidate columns against constant c.
+
+    Returns (lt, eq, gt) planes partitioning ``candidates``. Classic bit-
+    sliced compare, MSB->LSB (reference: fragment.go:1035 rangeLT et al.)
+    — the loop is unrolled at trace time (depth is static).
+    """
+    depth = mag_planes.shape[0]
+    zeros = jnp.zeros_like(candidates)
+    eq = candidates
+    lt = zeros
+    gt = zeros
+    for k in range(depth - 1, -1, -1):
+        pk = mag_planes[k]
+        bit = cbits[k]
+        lt = lt | jnp.where(bit, eq & ~pk, zeros)
+        gt = gt | jnp.where(bit, zeros, eq & pk)
+        eq = eq & jnp.where(bit, pk, ~pk)
+    # If |c| exceeds the representable magnitude every candidate is < c.
+    lt = jnp.where(coverflow, candidates, lt)
+    eq = jnp.where(coverflow, zeros, eq)
+    gt = jnp.where(coverflow, zeros, gt)
+    return lt, eq, gt
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _compare_kernel(planes, op, cbits, cover, cneg, c2bits, c2over, c2neg):
+    exists = planes[EXISTS]
+    sign = planes[SIGN]
+    mags = planes[OFFSET:]
+    zeros = jnp.zeros_like(exists)
+    neg_rows = exists & sign
+    pos_rows = exists & ~sign
+
+    def signed_partition(cbits, cover, cneg):
+        """(lt, eq, gt) of stored values vs signed constant c."""
+        # Compare magnitudes within each sign class.
+        plt, peq, pgt = _mag_compare(mags, pos_rows, cbits, cover)
+        nlt, neq, ngt = _mag_compare(mags, neg_rows, cbits, cover)
+        # c >= 0: negatives all < c; positives by magnitude.
+        lt_cpos = neg_rows | plt
+        eq_cpos = peq
+        gt_cpos = pgt
+        # c < 0: positives all > c; negatives by *reversed* magnitude.
+        lt_cneg = ngt
+        eq_cneg = neq
+        gt_cneg = pos_rows | nlt
+        lt = jnp.where(cneg, lt_cneg, lt_cpos)
+        eq = jnp.where(cneg, eq_cneg, eq_cpos)
+        gt = jnp.where(cneg, gt_cneg, gt_cpos)
+        return lt, eq, gt
+
+    lt, eq, gt = signed_partition(cbits, cover, cneg)
+    if op == EQ:
+        return eq
+    if op == NE:
+        return exists & ~eq
+    if op == LT:
+        return lt
+    if op == LE:
+        return lt | eq
+    if op == GT:
+        return gt
+    if op == GE:
+        return gt | eq
+    if op == BETWEEN:
+        lt2, eq2, _ = signed_partition(c2bits, c2over, c2neg)
+        return (gt | eq) & (lt2 | eq2)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def bsi_compare(planes, op: str, value: int, value2: int | None = None):
+    """Filter columns of a BSI plane stack by a signed predicate.
+
+    ``value``/``value2`` are *stored-space* values (caller subtracts the
+    field base first, as the reference does in field.go value ranges).
+    Returns a plane of matching columns.
+    """
+    depth = planes.shape[0] - OFFSET
+    cbits, cover, cneg = value_bits(int(value), depth)
+    if value2 is None:
+        c2bits, c2over, c2neg = cbits, cover, cneg
+    else:
+        c2bits, c2over, c2neg = value_bits(int(value2), depth)
+    return _compare_kernel(
+        planes, op,
+        jnp.asarray(cbits), jnp.asarray(cover), jnp.asarray(cneg),
+        jnp.asarray(c2bits), jnp.asarray(c2over), jnp.asarray(c2neg),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side encode (ingest path)
+# ---------------------------------------------------------------------------
+
+
+def bits_needed(value: int) -> int:
+    """Magnitude bit-depth needed to store |value| (reference:
+    roaring bitDepth calc in fragment.go importValue)."""
+    mag = abs(int(value))
+    return max(1, mag.bit_length())
+
+
+def encode_values(cols, values, depth: int, words: int) -> np.ndarray:
+    """Host-side: build a BSI plane stack ``uint32[2+depth, words]`` from
+    (column offset, stored value) pairs — the ingest-time analog of the
+    reference's importValue (fragment.go:1947) writing exists/sign/magnitude
+    rows. Vectorized numpy; later columns win on duplicates is NOT handled
+    (callers dedupe, as the reference's batcher does)."""
+    from pilosa_tpu.ops.bitmap import bits_to_plane
+
+    cols = np.asarray(cols, dtype=np.int64)
+    values = np.asarray(values, dtype=np.int64)
+    mags_check = np.abs(values)
+    if values.size and int(mags_check.max()) >> depth != 0:
+        # The reference grows bitDepth on import (fragment.go importValue);
+        # callers here must re-encode at a wider depth — never truncate.
+        raise ValueError(
+            f"value magnitude {int(mags_check.max())} exceeds bit depth {depth}"
+        )
+    planes = np.zeros((OFFSET + depth, words), dtype=np.uint32)
+    planes[EXISTS] = bits_to_plane(cols, words)
+    planes[SIGN] = bits_to_plane(cols[values < 0], words)
+    mags = np.abs(values)
+    for k in range(depth):
+        sel = (mags >> k) & 1 == 1
+        if sel.any():
+            planes[OFFSET + k] = bits_to_plane(cols[sel], words)
+    return planes
+
+
+# ---------------------------------------------------------------------------
+# Aggregates
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def bsi_plane_popcounts(planes, filt):
+    """Per-magnitude-plane popcounts split by sign, plus the filtered count.
+
+    Device returns int32s only; the host assembles the exact 64-bit sum
+    ``sum = Σ pos[k]<<k − Σ neg[k]<<k`` with Python ints (reference:
+    fragment.go:724 sum — same plane-popcount algorithm, scalar Go loop).
+    Returns (count, pos_counts[depth], neg_counts[depth]).
+    """
+    exists = planes[EXISTS]
+    sign = planes[SIGN]
+    mags = planes[OFFSET:]
+    rows = exists & filt
+    pos = rows & ~sign
+    neg = rows & sign
+    count = jnp.sum(_pc(rows))
+    pos_counts = jnp.sum(_pc(mags & pos[None, :]), axis=-1)
+    neg_counts = jnp.sum(_pc(mags & neg[None, :]), axis=-1)
+    return count, pos_counts, neg_counts
+
+
+def bsi_sum(planes, filt):
+    """Exact (sum, count) of stored values over filtered columns."""
+    count, pos_counts, neg_counts = bsi_plane_popcounts(planes, filt)
+    pos_counts = np.asarray(pos_counts, dtype=np.int64)
+    neg_counts = np.asarray(neg_counts, dtype=np.int64)
+    total = 0
+    for k in range(pos_counts.shape[0]):
+        total += (int(pos_counts[k]) - int(neg_counts[k])) << k
+    return total, int(count)
+
+
+def _walk_max_mag(S, mags):
+    """Narrow candidate set to columns with maximal magnitude; returns
+    (bits MSB-walk decisions as bool[depth] LSB-first, final set)."""
+    depth = mags.shape[0]
+    bits = [None] * depth
+    for k in range(depth - 1, -1, -1):
+        t = S & mags[k]
+        ne = _any(t)
+        S = jnp.where(ne, t, S)
+        bits[k] = ne
+    return jnp.stack(bits), S
+
+
+def _walk_min_mag(S, mags):
+    """Narrow candidate set to columns with minimal magnitude."""
+    depth = mags.shape[0]
+    bits = [None] * depth
+    for k in range(depth - 1, -1, -1):
+        t = S & ~mags[k]
+        ne = _any(t)
+        S = jnp.where(ne, t, S)
+        bits[k] = ~ne  # no candidate with bit clear => all remaining have it set
+    return jnp.stack(bits), S
+
+
+@functools.partial(jax.jit, static_argnames=("want_max",))
+def _minmax_kernel(planes, filt, want_max):
+    exists = planes[EXISTS]
+    sign = planes[SIGN]
+    mags = planes[OFFSET:]
+    rows = exists & filt
+    neg = rows & sign
+    pos = rows & ~sign
+    has_neg = _any(neg)
+    has_pos = _any(pos)
+    if want_max:
+        # max: largest positive if any, else least-magnitude negative.
+        pbits, pS = _walk_max_mag(pos, mags)
+        nbits, nS = _walk_min_mag(neg, mags)
+        bits = jnp.where(has_pos, pbits, nbits)
+        final = jnp.where(has_pos, pS, nS)
+        negative = ~has_pos
+    else:
+        # min: largest-magnitude negative if any, else smallest positive.
+        nbits, nS = _walk_max_mag(neg, mags)
+        pbits, pS = _walk_min_mag(pos, mags)
+        bits = jnp.where(has_neg, nbits, pbits)
+        final = jnp.where(has_neg, nS, pS)
+        negative = has_neg
+    cnt = jnp.sum(_pc(final))
+    total = jnp.sum(_pc(rows))
+    return bits, negative, cnt, total
+
+
+def _assemble(bits, negative) -> int:
+    v = 0
+    b = np.asarray(bits)
+    for k in range(b.shape[0]):
+        if b[k]:
+            v |= 1 << k
+    return -v if negative else v
+
+
+def bsi_min(planes, filt):
+    """(min stored value, count achieving it, total filtered count).
+    Reference: fragment.go:754 minUnsigned/min."""
+    bits, negative, cnt, total = _minmax_kernel(planes, filt, False)
+    if int(total) == 0:
+        return 0, 0, 0
+    return _assemble(bits, bool(negative)), int(cnt), int(total)
+
+
+def bsi_max(planes, filt):
+    """(max stored value, count achieving it, total filtered count).
+    Reference: fragment.go:817 maxUnsigned/max."""
+    bits, negative, cnt, total = _minmax_kernel(planes, filt, True)
+    if int(total) == 0:
+        return 0, 0, 0
+    return _assemble(bits, bool(negative)), int(cnt), int(total)

@@ -1,0 +1,81 @@
+"""GroupBy pair-count kernels — MXU matmul over bit planes.
+
+The reference's GroupBy walks nested row iterators per shard and popcounts
+each intersection one pair at a time (reference: executor.go:3918
+executeGroupByShard, :3176 groupByIterator). The TPU-native formulation:
+the matrix of intersection counts between two row sets
+
+    C[i, j] = popcount(A_i AND B_j)
+
+is exactly a matmul over {0,1} bit lanes: expand each uint32 word into 32
+bf16 lanes and contract over the 2^20-column axis on the MXU with f32
+accumulation (exact for counts < 2^24 > shard width). This turns the
+reference's scalar hot loop into the systolic array's native op — the
+core of BASELINE.json config 3 (TopK+GroupBy on SSB) and the north-star
+GroupBy speedup.
+
+Column blocking keeps the bf16 expansion in VMEM-sized chunks instead of
+materializing ``rows x 2^20`` bf16 in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Words per column-block of the matmul: 2048 words = 65536 bit-columns
+# -> bf16 chunk of [R, 65536] = 128KiB per row, MXU-friendly.
+BLOCK_WORDS = 2048
+
+
+def _expand_bits_bf16(words):
+    """uint32[..., Wc] -> bf16[..., Wc*32] of 0/1 lanes (LSB-first)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("block_words",))
+def pair_counts(a, b, block_words: int = BLOCK_WORDS):
+    """int32[R1, R2] of pairwise intersection popcounts of two row sets
+    ``uint32[R1, W]`` x ``uint32[R2, W]``.
+
+    Used by GroupBy (rows of field1 x rows of field2) and by grouped
+    aggregates (group bitmaps x BSI magnitude planes)."""
+    r1, w = a.shape
+    r2, _ = b.shape
+    bw = min(block_words, w)
+    # Pad W to a multiple of the block (zero words contribute nothing).
+    pad = (-w) % bw
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad)))
+        b = jnp.pad(b, ((0, 0), (0, pad)))
+    nblocks = a.shape[1] // bw
+    a_blocks = a.reshape(r1, nblocks, bw).transpose(1, 0, 2)
+    b_blocks = b.reshape(r2, nblocks, bw).transpose(1, 0, 2)
+
+    def step(acc, ab):
+        a_w, b_w = ab
+        a_bits = _expand_bits_bf16(a_w)  # [R1, bw*32]
+        b_bits = _expand_bits_bf16(b_w)  # [R2, bw*32]
+        acc = acc + jax.lax.dot_general(
+            a_bits,
+            b_bits,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    acc0 = jnp.zeros((r1, r2), dtype=jnp.float32)
+    acc, _ = lax.scan(step, acc0, (a_blocks, b_blocks))
+    return acc.astype(jnp.int32)
+
+
+@jax.jit
+def masked_pair_counts(a, b, filt):
+    """pair_counts with both sides pre-intersected by a filter plane
+    (reference: GroupBy's optional filter argument, executor.go:3277)."""
+    return pair_counts(a & filt[None, :], b & filt[None, :])

@@ -1,0 +1,32 @@
+"""Top-N / Top-K over row counts.
+
+The reference maintains per-fragment rank caches and merges per-fragment
+count heaps (reference: cache.go:130 rankCache, executor.go:2535
+topKFragments / :2586 mergerator). On TPU we skip caches entirely
+(SURVEY.md §7 design mapping): counting every row is one fused
+popcount-reduce over the fragment tensor and ``jax.lax.top_k`` ranks on
+device — recounting is cheaper than cache maintenance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+from pilosa_tpu.ops.bitmap import row_counts
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_kernel(planes, filt, k):
+    return lax.top_k(row_counts(planes, filt), k)
+
+
+def top_rows(planes, k: int, filt=None):
+    """(counts, plane_indices) of the k highest-count rows of a fragment
+    tensor ``uint32[R, W]``; caller maps plane indices back to row IDs and
+    merges across shards (reference: executor.go:2357 executeTopK reduce).
+    """
+    k = min(int(k), planes.shape[0])
+    return _topk_kernel(planes, filt, k)

@@ -1,0 +1,106 @@
+"""L0 bitmap kernel tests against a numpy set-semantics oracle.
+
+Mirrors the reference's container-op tests (reference:
+roaring/roaring_test.go union/intersect/difference/xor cases) but
+property-style over random column sets.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitmap as B
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+WORDS = 1 << 10  # small plane (32768 columns) for fast tests
+NBITS = WORDS * 32
+
+
+def rand_cols(rng, n, nbits=NBITS):
+    return np.unique(rng.integers(0, nbits, size=n))
+
+
+def to_set(cols):
+    return set(int(c) for c in cols)
+
+
+@pytest.mark.parametrize("n", [0, 1, 100, 5000])
+def test_bits_roundtrip(rng, n):
+    cols = rand_cols(rng, n)
+    plane = B.bits_to_plane(cols, WORDS)
+    out = B.plane_to_bits(plane)
+    assert to_set(out) == to_set(cols)
+
+
+def test_algebra_matches_set_oracle(rng):
+    a_cols = rand_cols(rng, 4000)
+    b_cols = rand_cols(rng, 3000)
+    a, b = B.bits_to_plane(a_cols, WORDS), B.bits_to_plane(b_cols, WORDS)
+    sa, sb = to_set(a_cols), to_set(b_cols)
+
+    cases = {
+        "and": (B.plane_and, sa & sb),
+        "or": (B.plane_or, sa | sb),
+        "xor": (B.plane_xor, sa ^ sb),
+        "andnot": (B.plane_andnot, sa - sb),
+    }
+    for name, (fn, expect) in cases.items():
+        got = to_set(B.plane_to_bits(np.asarray(fn(a, b))))
+        assert got == expect, name
+
+
+def test_counts(rng):
+    a_cols = rand_cols(rng, 4000)
+    b_cols = rand_cols(rng, 3000)
+    a, b = B.bits_to_plane(a_cols, WORDS), B.bits_to_plane(b_cols, WORDS)
+    assert int(B.plane_count(a)) == len(to_set(a_cols))
+    assert int(B.plane_intersection_count(a, b)) == len(to_set(a_cols) & to_set(b_cols))
+
+
+def test_not_within_existence(rng):
+    exist_cols = rand_cols(rng, 5000)
+    a_cols = exist_cols[::3]
+    ex = B.bits_to_plane(exist_cols, WORDS)
+    a = B.bits_to_plane(a_cols, WORDS)
+    got = to_set(B.plane_to_bits(np.asarray(B.plane_not(a, ex))))
+    assert got == to_set(exist_cols) - to_set(a_cols)
+
+
+def test_shift(rng):
+    cols = rand_cols(rng, 2000, NBITS - 1)
+    plane = B.bits_to_plane(cols, WORDS)
+    got = to_set(B.plane_to_bits(np.asarray(B.plane_shift(plane))))
+    assert got == {c + 1 for c in to_set(cols)}
+
+
+def test_shift_drops_last_bit():
+    plane = B.bits_to_plane([NBITS - 1, 5], WORDS)
+    got = to_set(B.plane_to_bits(np.asarray(B.plane_shift(plane))))
+    assert got == {6}
+
+
+@pytest.mark.parametrize(
+    "start,end",
+    [(0, 0), (0, 32), (5, 37), (100, 100), (31, 33), (0, NBITS), (1000, 1003)],
+)
+def test_range_mask(start, end):
+    m = np.asarray(B.plane_range_mask(start, end, WORDS))
+    assert to_set(B.plane_to_bits(m)) == set(range(start, end))
+
+
+def test_row_counts(rng):
+    rows = [rand_cols(rng, n) for n in (10, 0, 3000, 77)]
+    planes = np.stack([B.bits_to_plane(r, WORDS) for r in rows])
+    filt_cols = rand_cols(rng, 8000)
+    filt = B.bits_to_plane(filt_cols, WORDS)
+    got = np.asarray(B.row_counts(planes))
+    assert got.tolist() == [len(to_set(r)) for r in rows]
+    gotf = np.asarray(B.row_counts(planes, filt))
+    assert gotf.tolist() == [len(to_set(r) & to_set(filt_cols)) for r in rows]
+
+
+def test_full_shard_shapes():
+    # Sanity at the real shard width (2^20 columns, reference
+    # shardwidth/helper.go:14).
+    assert WORDS_PER_SHARD * 32 == SHARD_WIDTH
+    plane = B.bits_to_plane([0, SHARD_WIDTH - 1], WORDS_PER_SHARD)
+    assert int(B.plane_count(plane)) == 2
