@@ -1,0 +1,28 @@
+"""Data model: the holder tree.
+
+Holder -> Index -> Field -> View -> Fragment, mirroring the reference's
+containment hierarchy (reference: holder.go:58, index.go:27, field.go:73,
+view.go:36, fragment.go:84) with a TPU-first storage design: fragments are
+host-canonical numpy bitmap planes with a versioned device (HBM) cache —
+the host side plays the role of RBF (mutable, durable), the device side is
+the scan path (SURVEY.md §7 design mapping: "RBF -> host-side shard store +
+async HBM upload").
+"""
+
+from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
+from pilosa_tpu.core.fragment import BSIFragment, SetFragment
+from pilosa_tpu.core.field import Field
+from pilosa_tpu.core.index import Index, EXISTENCE_FIELD
+from pilosa_tpu.core.holder import Holder
+
+__all__ = [
+    "BSIFragment",
+    "EXISTENCE_FIELD",
+    "Field",
+    "FieldOptions",
+    "FieldType",
+    "Holder",
+    "Index",
+    "IndexOptions",
+    "SetFragment",
+]

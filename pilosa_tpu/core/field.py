@@ -1,0 +1,190 @@
+"""Field: a typed attribute of an index.
+
+Reference: field.go:73. A field owns views (variants of its data — the
+standard view plus time-quantum views, reference: view.go:26-33), each view
+holding one fragment per shard. Int-like fields (int/decimal/timestamp)
+store BSI fragments; set-like fields store bitmap-row fragments. Row-key
+translation lives on the field (reference: field.go:449).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+from typing import Dict, Iterable, List, Optional, Set
+
+from pilosa_tpu.core import timeq
+from pilosa_tpu.core.fragment import BSIFragment, SetFragment
+from pilosa_tpu.core.schema import (
+    BOOL_FALSE_ROW,
+    BOOL_TRUE_ROW,
+    FieldOptions,
+    FieldType,
+)
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+_TIME_UNITS_PER_S = {"s": 1, "ms": 1000, "us": 1_000_000, "ns": 1_000_000_000}
+
+
+class Field:
+    def __init__(self, index_name: str, name: str, options: FieldOptions,
+                 path: Optional[str] = None):
+        self.index_name = index_name
+        self.name = name
+        self.options = options
+        self.path = path
+        if options.type == FieldType.TIME:
+            timeq.validate_quantum(options.time_quantum)
+        # view name -> shard -> fragment
+        self.views: Dict[str, Dict[int, SetFragment]] = {}
+        # BSI storage (int/decimal/timestamp): shard -> BSIFragment
+        self.bsi: Dict[int, BSIFragment] = {}
+        self.translate = (
+            TranslateStore(self._translate_path(), start=1) if options.keys else None
+        )
+
+    def _translate_path(self) -> Optional[str]:
+        if self.path is None:
+            return None
+        return os.path.join(self.path, "keys.jsonl")
+
+    # -- value <-> stored mapping (BSI) -------------------------------------
+
+    def to_stored(self, value) -> int:
+        """External value -> stored integer (reference: field.go bsiGroup
+        base/scale handling; decimal scale field.go:293)."""
+        t = self.options.type
+        if t == FieldType.DECIMAL:
+            scaled = round(float(value) * (10 ** self.options.scale))
+            return int(scaled) - self.options.base
+        if t == FieldType.TIMESTAMP:
+            if isinstance(value, str):
+                value = dt.datetime.fromisoformat(value.replace("Z", "+00:00"))
+            if isinstance(value, dt.datetime):
+                if value.tzinfo is None:
+                    value = value.replace(tzinfo=dt.timezone.utc)
+                value = value.timestamp() * _TIME_UNITS_PER_S[self.options.time_unit]
+            return int(round(value)) - self.options.base
+        if self.options.min is not None and value < self.options.min:
+            raise ValueError(f"value {value} < field min {self.options.min}")
+        if self.options.max is not None and value > self.options.max:
+            raise ValueError(f"value {value} > field max {self.options.max}")
+        return int(value) - self.options.base
+
+    def from_stored(self, stored: int):
+        t = self.options.type
+        raw = stored + self.options.base
+        if t == FieldType.DECIMAL:
+            return raw / (10 ** self.options.scale)
+        return raw
+
+    # -- fragment accessors --------------------------------------------------
+
+    def fragment(self, shard: int, view: str = timeq.VIEW_STANDARD,
+                 create: bool = False) -> Optional[SetFragment]:
+        frags = self.views.get(view)
+        if frags is None:
+            if not create:
+                return None
+            frags = self.views[view] = {}
+        frag = frags.get(shard)
+        if frag is None:
+            if not create:
+                return None
+            frag = frags[shard] = SetFragment(shard)
+        return frag
+
+    def bsi_fragment(self, shard: int, create: bool = False) -> Optional[BSIFragment]:
+        frag = self.bsi.get(shard)
+        if frag is None and create:
+            frag = self.bsi[shard] = BSIFragment(shard)
+        return frag
+
+    def shards(self) -> Set[int]:
+        out: Set[int] = set(self.bsi)
+        for frags in self.views.values():
+            out.update(frags)
+        return out
+
+    def view_names(self) -> List[str]:
+        return sorted(self.views)
+
+    # -- write path ----------------------------------------------------------
+
+    def _write_views(self, timestamp: Optional[dt.datetime]) -> List[str]:
+        views = [timeq.VIEW_STANDARD]
+        if timestamp is not None:
+            if self.options.type != FieldType.TIME:
+                raise ValueError(f"field {self.name} does not support timestamps")
+            views += timeq.views_by_time(timestamp, self.options.time_quantum)
+        return views
+
+    def set_bit(self, row: int, col: int,
+                timestamp: Optional[dt.datetime] = None) -> bool:
+        """Set (row, col); mutex/bool clear other rows of the column first
+        (reference: fragment.go setBit + mutex handling
+        fragment.go:1787)."""
+        shard, pos = divmod(col, SHARD_WIDTH)
+        changed = False
+        for view in self._write_views(timestamp):
+            frag = self.fragment(shard, view, create=True)
+            if self.options.type in (FieldType.MUTEX, FieldType.BOOL):
+                changed |= frag.clear_column(pos, except_row=row)
+            changed |= frag.set_bit(row, pos)
+        return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        shard, pos = divmod(col, SHARD_WIDTH)
+        changed = False
+        # Clears apply to every view (reference: fragment clearBit per view).
+        for view in list(self.views):
+            frag = self.fragment(shard, view)
+            if frag is not None:
+                changed |= frag.clear_bit(row, pos)
+        return changed
+
+    def set_bool(self, col: int, value: bool) -> bool:
+        return self.set_bit(BOOL_TRUE_ROW if value else BOOL_FALSE_ROW, col)
+
+    def set_value(self, col: int, value) -> None:
+        shard, pos = divmod(col, SHARD_WIDTH)
+        frag = self.bsi_fragment(shard, create=True)
+        frag.set_value(pos, self.to_stored(value))
+
+    def set_values(self, cols: Iterable[int], values: Iterable) -> None:
+        by_shard: Dict[int, tuple] = {}
+        for col, val in zip(cols, values):
+            shard, pos = divmod(col, SHARD_WIDTH)
+            by_shard.setdefault(shard, ([], []))
+            by_shard[shard][0].append(pos)
+            by_shard[shard][1].append(self.to_stored(val))
+        for shard, (poss, vals) in by_shard.items():
+            self.bsi_fragment(shard, create=True).set_values(poss, vals)
+
+    def clear_value(self, col: int) -> bool:
+        shard, pos = divmod(col, SHARD_WIDTH)
+        frag = self.bsi_fragment(shard)
+        return frag.clear_value(pos) if frag else False
+
+    def value(self, col: int):
+        shard, pos = divmod(col, SHARD_WIDTH)
+        frag = self.bsi_fragment(shard)
+        if frag is None:
+            return None
+        stored = frag.value(pos)
+        return None if stored is None else self.from_stored(stored)
+
+    # -- read helpers ----------------------------------------------------------
+
+    def range_views(self, from_t: Optional[dt.datetime],
+                    to_t: Optional[dt.datetime]) -> List[str]:
+        """Views covering a time range query (reference: field.go:1001
+        viewsByTimeRange dispatch)."""
+        if from_t is None and to_t is None:
+            return [timeq.VIEW_STANDARD]
+        if self.options.type != FieldType.TIME:
+            raise ValueError(f"field {self.name} is not a time field")
+        lo = from_t or dt.datetime(1, 1, 1)
+        hi = to_t or dt.datetime(9999, 1, 1)
+        return timeq.views_by_time_range(lo, hi, self.options.time_quantum)

@@ -1,0 +1,257 @@
+"""Fragments: per-(field, view, shard) bitmap storage.
+
+The reference's fragment (fragment.go:84) is a roaring bitmap addressed as
+``row * ShardWidth + column`` backed by an RBF B-tree of containers. Here a
+fragment is:
+
+- **host canonical**: a mutable numpy ``uint32[capacity, WORDS]`` plane
+  matrix plus a row-id -> plane-index map (rows are sparse in row-id space;
+  dense in plane slots). All writes land here — the host side is the
+  mutability story (the reference's RBF WAL/checkpoint analog, SURVEY.md §7
+  "Mutability on device").
+- **device cache**: a versioned, lazily-uploaded ``jax.Array`` of the same
+  planes. Queries read only the device view; a write bumps the version and
+  the next query re-uploads (coarse-grained; incremental merge is a later
+  optimization).
+
+Row capacity grows in powers of two so jitted kernels see few distinct
+shapes (XLA executable cache friendliness — the analog of the reference
+reusing container code paths across fragments).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from pilosa_tpu.ops import bsi as bsiops
+from pilosa_tpu.ops.bitmap import bits_to_plane
+from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
+
+_MIN_CAPACITY = 8
+
+
+def _grow_rows(planes: np.ndarray, need: int) -> np.ndarray:
+    cap = max(_MIN_CAPACITY, planes.shape[0])
+    while cap < need:
+        cap *= 2
+    if cap == planes.shape[0]:
+        return planes
+    out = np.zeros((cap, planes.shape[1]), dtype=np.uint32)
+    out[: planes.shape[0]] = planes
+    return out
+
+
+class SetFragment:
+    """Bitmap rows for set/mutex/bool/time fields (one per view+shard)."""
+
+    def __init__(self, shard: int, words: int = WORDS_PER_SHARD):
+        self.shard = shard
+        self.words = words
+        self.row_index: Dict[int, int] = {}  # row id -> plane slot
+        self.row_ids: List[int] = []  # plane slot -> row id
+        self.planes = np.zeros((0, words), dtype=np.uint32)
+        self.version = 0
+        self._device: Optional[jax.Array] = None
+        self._device_version = -1
+
+    # -- host write path ---------------------------------------------------
+
+    def _slot(self, row: int) -> int:
+        s = self.row_index.get(row)
+        if s is None:
+            s = len(self.row_ids)
+            self.planes = _grow_rows(self.planes, s + 1)
+            self.row_index[row] = s
+            self.row_ids.append(row)
+        return s
+
+    def set_bit(self, row: int, col: int) -> bool:
+        """Set bit; returns True if it changed (reference: fragment.go
+        setBit's changed flag feeding import counts)."""
+        s = self._slot(row)
+        w, b = divmod(col, BITS_PER_WORD)
+        mask = np.uint32(1) << np.uint32(b)
+        old = self.planes[s, w]
+        if old & mask:
+            return False
+        self.planes[s, w] = old | mask
+        self.version += 1
+        return True
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        s = self.row_index.get(row)
+        if s is None:
+            return False
+        w, b = divmod(col, BITS_PER_WORD)
+        mask = np.uint32(1) << np.uint32(b)
+        old = self.planes[s, w]
+        if not (old & mask):
+            return False
+        self.planes[s, w] = old & ~mask
+        self.version += 1
+        return True
+
+    def set_many(self, rows: Sequence[int], cols: Sequence[int]) -> int:
+        """Bulk import of (row, col) pairs (reference: fragment.go:1498
+        bulkImport). Returns number of changed bits."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        changed = 0
+        for row in np.unique(rows):
+            s = self._slot(int(row))
+            sel = cols[rows == row]
+            before = int(np.sum(popcount_words(self.planes[s])))
+            self.planes[s] |= bits_to_plane(sel, self.words)
+            changed += int(np.sum(popcount_words(self.planes[s]))) - before
+        self.version += 1
+        return changed
+
+    def clear_column(self, col: int, except_row: Optional[int] = None) -> bool:
+        """Clear a column across all rows (mutex semantics, reference:
+        fragment.go:1787 bulkImportMutex / unprotectedClearMutex)."""
+        if not self.row_ids:
+            return False
+        w, b = divmod(col, BITS_PER_WORD)
+        mask = np.uint32(1) << np.uint32(b)
+        col_words = self.planes[: len(self.row_ids), w]
+        to_clear = (col_words & mask) != 0
+        if except_row is not None and except_row in self.row_index:
+            to_clear[self.row_index[except_row]] = False
+        if not to_clear.any():
+            return False
+        col_words[to_clear] &= ~mask
+        self.version += 1
+        return True
+
+    def import_row_plane(self, row: int, plane: np.ndarray, clear: bool = False):
+        """Merge (OR) or replace a whole row plane (reference:
+        fragment.go:2038 importRoaring / :2053 ImportRoaringClearAndSet)."""
+        s = self._slot(row)
+        if clear:
+            self.planes[s] = plane
+        else:
+            self.planes[s] |= plane
+        self.version += 1
+
+    # -- host read path ----------------------------------------------------
+
+    def row_plane(self, row: int) -> np.ndarray:
+        s = self.row_index.get(row)
+        if s is None:
+            return np.zeros(self.words, dtype=np.uint32)
+        return self.planes[s]
+
+    def has_row(self, row: int) -> bool:
+        return row in self.row_index
+
+    def existing_rows(self) -> List[int]:
+        return sorted(self.row_index)
+
+    # -- device path -------------------------------------------------------
+
+    def device_planes(self) -> jax.Array:
+        """Upload-once view of all plane slots ``uint32[capacity, W]``
+        (slots beyond len(row_ids) are zero padding)."""
+        if self._device is None or self._device_version != self.version:
+            self._device = jax.device_put(self.planes)
+            self._device_version = self.version
+        return self._device
+
+    def device_row(self, row: int) -> jax.Array:
+        s = self.row_index.get(row)
+        planes = self.device_planes()
+        if s is None:
+            return jax.numpy.zeros((self.words,), dtype=jax.numpy.uint32)
+        return planes[s]
+
+
+def popcount_words(words: np.ndarray) -> np.ndarray:
+    """Host-side popcount per word (numpy has no popcount below 2.0's
+    bit_count for arrays on all dtypes; unpackbits is fast enough here)."""
+    return np.unpackbits(words.view(np.uint8)).reshape(words.shape + (32,)).sum(-1)
+
+
+class BSIFragment:
+    """Bit-sliced integer storage for int/decimal/timestamp fields.
+
+    Plane stack layout per ops/bsi.py: [exists, sign, magnitude...]
+    (reference: fragment.go:62-66). Bit depth grows on demand like the
+    reference's importValue (fragment.go:1947).
+    """
+
+    def __init__(self, shard: int, words: int = WORDS_PER_SHARD, depth: int = 1):
+        self.shard = shard
+        self.words = words
+        self.depth = depth
+        self.planes = np.zeros((bsiops.OFFSET + depth, words), dtype=np.uint32)
+        self.version = 0
+        self._device: Optional[jax.Array] = None
+        self._device_version = -1
+
+    def _ensure_depth(self, depth: int):
+        if depth <= self.depth:
+            return
+        out = np.zeros((bsiops.OFFSET + depth, self.words), dtype=np.uint32)
+        out[: self.planes.shape[0]] = self.planes
+        self.planes = out
+        self.depth = depth
+
+    def set_value(self, col: int, value: int):
+        self.set_values([col], [value])
+
+    def set_values(self, cols: Sequence[int], values: Sequence[int]):
+        """Write (col, value) pairs; later duplicates win (reference:
+        fragment.go:1947 importValue clears then sets)."""
+        cols = np.asarray(cols, dtype=np.int64)
+        values = np.asarray(values, dtype=np.int64)
+        if cols.size == 0:
+            return
+        # Last write wins per column.
+        _, last = np.unique(cols[::-1], return_index=True)
+        idx = cols.size - 1 - last
+        cols, values = cols[idx], values[idx]
+        need = max(bsiops.bits_needed(int(values.min())),
+                   bsiops.bits_needed(int(values.max())))
+        self._ensure_depth(need)
+        clear = ~bits_to_plane(cols, self.words)
+        self.planes &= clear[None, :]  # clear all planes for these columns
+        update = bsiops.encode_values(cols, values, self.depth, self.words)
+        self.planes[: update.shape[0]] |= update
+        self.version += 1
+
+    def clear_value(self, col: int) -> bool:
+        w, b = divmod(col, BITS_PER_WORD)
+        mask = np.uint32(1) << np.uint32(b)
+        if not (self.planes[bsiops.EXISTS, w] & mask):
+            return False
+        self.planes[:, w] &= ~mask
+        self.version += 1
+        return True
+
+    def value(self, col: int) -> Optional[int]:
+        """Point read (host): reconstruct the stored value of a column."""
+        w, b = divmod(col, BITS_PER_WORD)
+        mask = np.uint32(1) << np.uint32(b)
+        if not (self.planes[bsiops.EXISTS, w] & mask):
+            return None
+        mag = 0
+        for k in range(self.depth):
+            if self.planes[bsiops.OFFSET + k, w] & mask:
+                mag |= 1 << k
+        if self.planes[bsiops.SIGN, w] & mask:
+            mag = -mag
+        return mag
+
+    def exists_plane(self) -> np.ndarray:
+        return self.planes[bsiops.EXISTS]
+
+    def device_planes(self) -> jax.Array:
+        if self._device is None or self._device_version != self.version:
+            self._device = jax.device_put(self.planes)
+            self._device_version = self.version
+        return self._device

@@ -1,0 +1,103 @@
+"""Holder: root container owning all indexes.
+
+Reference: holder.go:58. Schema persistence is a JSON document on the
+holder's data dir (the single-controller analog of the reference's etcd
+Schemator, SURVEY.md §7 "etcd/disco -> host process owns schema").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.schema import FieldOptions, IndexOptions
+
+
+class Holder:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.indexes: Dict[str, Index] = {}
+        if path:
+            os.makedirs(path, exist_ok=True)
+            self._load_schema()
+
+    # -- schema persistence ------------------------------------------------------
+
+    def _schema_path(self) -> str:
+        return os.path.join(self.path, "schema.json")
+
+    def _load_schema(self) -> None:
+        if not os.path.exists(self._schema_path()):
+            return
+        with open(self._schema_path()) as f:
+            doc = json.load(f)
+        for idx_doc in doc.get("indexes", []):
+            idx = self._new_index(idx_doc["name"], IndexOptions.from_json(idx_doc["options"]))
+            for f_doc in idx_doc.get("fields", []):
+                if f_doc["name"] not in idx.fields:
+                    idx.create_field(f_doc["name"], FieldOptions.from_json(f_doc["options"]))
+
+    def save_schema(self) -> None:
+        if not self.path:
+            return
+        doc = {
+            "indexes": [
+                {
+                    "name": idx.name,
+                    "options": idx.options.to_json(),
+                    "fields": [
+                        {"name": f.name, "options": f.options.to_json()}
+                        for f in idx.public_fields()
+                    ],
+                }
+                for idx in sorted(self.indexes.values(), key=lambda i: i.name)
+            ]
+        }
+        tmp = self._schema_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self._schema_path())
+
+    # -- index management --------------------------------------------------------
+
+    def _index_path(self, name: str) -> Optional[str]:
+        return os.path.join(self.path, "indexes", name) if self.path else None
+
+    def _new_index(self, name: str, options: Optional[IndexOptions]) -> Index:
+        idx = Index(name, options, path=self._index_path(name))
+        self.indexes[name] = idx
+        return idx
+
+    def create_index(self, name: str, options: Optional[IndexOptions] = None) -> Index:
+        if name in self.indexes:
+            raise ValueError(f"index {name!r} already exists")
+        idx = self._new_index(name, options)
+        self.save_schema()
+        return idx
+
+    def index(self, name: str) -> Index:
+        idx = self.indexes.get(name)
+        if idx is None:
+            raise KeyError(f"index {name!r} not found")
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        del self.indexes[name]
+        self.save_schema()
+
+    def schema(self) -> List[dict]:
+        """JSON-facing schema (reference: api.go Schema / schema.go:502)."""
+        return [
+            {
+                "name": idx.name,
+                "options": idx.options.to_json(),
+                "shardWidth": 1 << 20,
+                "fields": [
+                    {"name": f.name, "options": f.options.to_json()}
+                    for f in idx.public_fields()
+                ],
+            }
+            for idx in sorted(self.indexes.values(), key=lambda i: i.name)
+        ]

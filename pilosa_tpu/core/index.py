@@ -1,0 +1,107 @@
+"""Index: a table of records (columns) with typed fields.
+
+Reference: index.go:27. Maintains the existence field ``_exists``
+(reference: index.go:384 existenceFieldName) so Not/All/Count(All) have a
+universe to complement against, and the record-key translate store when
+``keys=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Set
+
+from pilosa_tpu.core.field import Field
+from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+EXISTENCE_FIELD = "_exists"
+EXISTENCE_ROW = 0
+
+
+class Index:
+    def __init__(self, name: str, options: Optional[IndexOptions] = None,
+                 path: Optional[str] = None):
+        if not name or not name[0].isalpha() or name != name.lower():
+            raise ValueError(f"invalid index name {name!r}")
+        self.name = name
+        self.options = options or IndexOptions()
+        self.path = path
+        self.fields: Dict[str, Field] = {}
+        self.translate = (
+            TranslateStore(self._translate_path(), start=0)
+            if self.options.keys else None
+        )
+        if self.options.track_existence:
+            self._create_field_object(EXISTENCE_FIELD, FieldOptions(type=FieldType.SET))
+
+    def _translate_path(self) -> Optional[str]:
+        return os.path.join(self.path, "keys.jsonl") if self.path else None
+
+    def _field_path(self, name: str) -> Optional[str]:
+        return os.path.join(self.path, "fields", name) if self.path else None
+
+    def _create_field_object(self, name: str, options: FieldOptions) -> Field:
+        field = Field(self.name, name, options, path=self._field_path(name))
+        self.fields[name] = field
+        return field
+
+    # -- schema ----------------------------------------------------------------
+
+    def create_field(self, name: str, options: Optional[FieldOptions] = None) -> Field:
+        if name in self.fields:
+            raise ValueError(f"field {name!r} already exists")
+        if not name or name != name.lower():
+            raise ValueError(f"invalid field name {name!r}")
+        return self._create_field_object(name, options or FieldOptions())
+
+    def field(self, name: str) -> Field:
+        f = self.fields.get(name)
+        if f is None:
+            raise KeyError(f"field {name!r} not found in index {self.name!r}")
+        return f
+
+    def delete_field(self, name: str) -> None:
+        if name == EXISTENCE_FIELD:
+            raise ValueError("cannot delete the existence field")
+        del self.fields[name]
+
+    def public_fields(self) -> List[Field]:
+        return [f for n, f in sorted(self.fields.items()) if n != EXISTENCE_FIELD]
+
+    # -- existence tracking ------------------------------------------------------
+
+    @property
+    def existence(self) -> Optional[Field]:
+        return self.fields.get(EXISTENCE_FIELD)
+
+    def add_exists(self, col: int) -> None:
+        """Record that a column exists (called on every write when
+        track_existence; reference: index.go existence updates via
+        fragment import paths)."""
+        if self.options.track_existence:
+            self.fields[EXISTENCE_FIELD].set_bit(EXISTENCE_ROW, col)
+
+    def existence_plane(self, shard: int):
+        """Dense existence row for a shard, or None if untracked."""
+        ex = self.existence
+        if ex is None:
+            return None
+        frag = ex.fragment(shard)
+        if frag is None:
+            return None
+        return frag.row_plane(EXISTENCE_ROW)
+
+    # -- shards ------------------------------------------------------------------
+
+    def shards(self) -> Set[int]:
+        """All shards holding data in any field (reference: the per-field
+        available-shards bitmaps unioned, field.go:454)."""
+        out: Set[int] = set()
+        for f in self.fields.values():
+            out |= f.shards()
+        return out or {0}
+
+    def max_column(self) -> int:
+        return (max(self.shards()) + 1) * SHARD_WIDTH

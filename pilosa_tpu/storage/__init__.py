@@ -1,0 +1,10 @@
+"""Host-side persistence: fragment files, snapshots, wire codecs.
+
+The TPU-native replacement for the reference's RBF storage engine (rbf/ —
+mmap'd B-tree of roaring containers with WAL): host-canonical dense planes
+serialized per fragment, with whole-holder save/load and tar snapshots.
+"""
+
+from pilosa_tpu.storage.store import load_holder_data, save_holder_data
+
+__all__ = ["load_holder_data", "save_holder_data"]

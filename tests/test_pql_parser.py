@@ -1,0 +1,108 @@
+"""PQL parser tests (reference: pql/pql_test.go behaviors)."""
+
+import pytest
+
+from pilosa_tpu.pql import parse
+from pilosa_tpu.pql.ast import Condition
+from pilosa_tpu.pql.parser import ParseError
+
+
+def one(src):
+    q = parse(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+def test_simple_row():
+    c = one("Row(f=1)")
+    assert c.name == "Row" and c.args == {"f": 1}
+
+
+def test_multiple_calls():
+    q = parse("Set(1, f=1)Set(2, f=2)")
+    assert [c.name for c in q.calls] == ["Set", "Set"]
+    assert q.calls[0].args == {"_col": 1, "f": 1}
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    inner = c.children[0]
+    assert inner.name == "Intersect"
+    assert [ch.args for ch in inner.children] == [{"a": 1}, {"b": 2}]
+
+
+def test_strings_and_escapes():
+    c = one('Row(f="it\\"s")')
+    assert c.args == {"f": 'it"s'}
+    c = one("Row(f='single')")
+    assert c.args == {"f": "single"}
+
+
+def test_conditions():
+    c = one("Row(n > 5)")
+    assert c.args["n"] == Condition(">", 5)
+    c = one("Row(n <= -3)")
+    assert c.args["n"] == Condition("<=", -3)
+    c = one("Row(3 < n < 7)")
+    assert c.args["n"] == Condition("between", [4, 6])
+    c = one("Row(3 <= n <= 7)")
+    assert c.args["n"] == Condition("between", [3, 7])
+    c = one("Row(n != null)")
+    assert c.args["n"] == Condition("!=", None)
+
+
+def test_positional_field():
+    c = one("TopN(myfield, n=5)")
+    assert c.args == {"_field": "myfield", "n": 5}
+
+
+def test_timestamp_positional():
+    c = one("Set(2, f=1, 2010-01-02T03:04)")
+    assert c.args["_col"] == 2
+    assert c.args["f"] == 1
+    assert c.args["_timestamp"] == "2010-01-02T03:04"
+
+
+def test_from_to_strings():
+    c = one("Row(f=1, from='2010-01-01T00:00', to='2011-01-01T00:00')")
+    assert c.args["from"] == "2010-01-01T00:00"
+
+
+def test_lists_and_bools():
+    c = one("ConstRow(columns=[1, 2, 'x'])")
+    assert c.args["columns"] == [1, 2, "x"]
+    c = one("Set(1, b=true)")
+    assert c.args["b"] is True
+
+
+def test_named_call_arg():
+    c = one("GroupBy(Rows(a), aggregate=Sum(field=v), limit=10)")
+    assert c.children[0].name == "Rows"
+    assert c.args["aggregate"].name == "Sum"
+    assert c.args["limit"] == 10
+
+
+def test_floats_negative():
+    c = one("Row(price > 1.5)")
+    assert c.args["price"] == Condition(">", 1.5)
+    c = one("Set(1, n=-42)")
+    assert c.args["n"] == -42
+
+
+def test_trailing_comma():
+    c = one("Row(f=1,)")
+    assert c.args == {"f": 1}
+
+
+@pytest.mark.parametrize("bad", [
+    "Row(f=", "row(f=1)", "Row(f=1))", "Row(@)", "Row(f==)",
+])
+def test_parse_errors(bad):
+    with pytest.raises(ParseError):
+        parse(bad)
+
+
+def test_repr_roundtrip_shape():
+    c = one("GroupBy(Rows(a), Rows(b), limit=2)")
+    assert "GroupBy" in repr(c) and "Rows" in repr(c)

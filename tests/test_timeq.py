@@ -1,0 +1,93 @@
+"""Time-quantum view tests vs a brute-force coverage oracle.
+
+Mirrors the reference's time tests (time_internal_test.go) — write views
+per timestamp and minimal covering sets for ranges.
+"""
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.core import timeq
+
+
+def test_views_by_time():
+    t = dt.datetime(2010, 1, 2, 3)
+    assert timeq.views_by_time(t, "YMDH") == [
+        "standard_2010",
+        "standard_201001",
+        "standard_20100102",
+        "standard_2010010203",
+    ]
+    assert timeq.views_by_time(t, "D") == ["standard_20100102"]
+
+
+def test_invalid_quantum():
+    for bad in ("X", "YD", "HY", "YMH"):
+        with pytest.raises(ValueError):
+            timeq.validate_quantum(bad)
+
+
+def _oracle_hours(views):
+    """Expand a view list to the set of hours it covers."""
+    hours = set()
+    for v in views:
+        stamp = v.split("_", 1)[1]
+        fmt = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}[len(stamp)]
+        unit = {4: "Y", 6: "M", 8: "D", 10: "H"}[len(stamp)]
+        start = dt.datetime.strptime(stamp, fmt)
+        end = timeq._next(start, unit)
+        t = start
+        while t < end:
+            hours.add(t)
+            t += dt.timedelta(hours=1)
+    return hours
+
+
+def _expected_hours(lo, hi):
+    out = set()
+    t = lo
+    while t < hi:
+        out.add(t)
+        t += dt.timedelta(hours=1)
+    return out
+
+
+@pytest.mark.parametrize(
+    "lo,hi",
+    [
+        (dt.datetime(2010, 1, 1), dt.datetime(2010, 1, 1)),
+        (dt.datetime(2010, 1, 1), dt.datetime(2011, 1, 1)),
+        (dt.datetime(2010, 11, 28, 5), dt.datetime(2012, 3, 2, 7)),
+        (dt.datetime(2010, 1, 1), dt.datetime(2010, 1, 2)),
+        (dt.datetime(2010, 12, 31, 23), dt.datetime(2011, 1, 1, 1)),
+        (dt.datetime(2009, 6, 15, 13), dt.datetime(2009, 6, 15, 14)),
+    ],
+)
+def test_range_cover_exact_ymdh(lo, hi):
+    views = timeq.views_by_time_range(lo, hi, "YMDH")
+    assert _oracle_hours(views) == _expected_hours(lo, hi)
+    # No duplicate coverage: total hours across views == exact count.
+    assert sum(len(_oracle_hours([v])) for v in views) == len(_expected_hours(lo, hi))
+
+
+def test_range_cover_snaps_to_finest_unit():
+    # Quantum "YMD": sub-day boundaries snap outward to whole days.
+    views = timeq.views_by_time_range(
+        dt.datetime(2010, 1, 1, 5), dt.datetime(2010, 1, 2, 7), "YMD"
+    )
+    assert views == ["standard_20100101", "standard_20100102"]
+
+
+def test_range_uses_coarse_views():
+    views = timeq.views_by_time_range(
+        dt.datetime(2010, 1, 1), dt.datetime(2012, 1, 1), "YMDH"
+    )
+    assert views == ["standard_2010", "standard_2011"]
+
+
+def test_range_month_edges():
+    views = timeq.views_by_time_range(
+        dt.datetime(2010, 11, 1), dt.datetime(2011, 2, 1), "YM"
+    )
+    assert views == ["standard_201011", "standard_201012", "standard_201101"]
