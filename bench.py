@@ -1,0 +1,97 @@
+"""Benchmark driver: the north-star query family from BASELINE.json —
+multi-shard GroupBy + TopN p50 through the full PQL path (config #3
+shape: two grouping fields over many shards; the reference hot paths are
+executor.go:3918 executeGroupByShard and :2357 executeTopK).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup over a single-threaded numpy CPU scan that
+mirrors the reference's per-pair container walk (AND + popcount per
+(group row, field row) pair per shard, roaring/roaring.go:711): >1 means
+this engine is faster than the CPU scan on this host.
+
+Run on real TPU hardware by the round driver; also runs on CPU.
+"""
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+SHARDS = 8  # noqa: E402 — heavy imports deferred to main()
+ROWS_A = 32
+ROWS_B = 32
+BITS_PER_ROW = 50_000
+
+
+def _build(rng, holder):
+    from pilosa_tpu.ops.bitmap import bits_to_plane
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    idx = holder.create_index("bench")
+    fa = idx.create_field("a")
+    fb = idx.create_field("b")
+    for shard in range(SHARDS):
+        frag_a = fa.fragment(shard, create=True)
+        for r in range(ROWS_A):
+            frag_a.import_row_plane(
+                r, bits_to_plane(rng.integers(0, SHARD_WIDTH, BITS_PER_ROW)))
+        frag_b = fb.fragment(shard, create=True)
+        for r in range(ROWS_B):
+            frag_b.import_row_plane(
+                r, bits_to_plane(rng.integers(0, SHARD_WIDTH, BITS_PER_ROW)))
+    return idx
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.ops.bitmap import host_popcount
+    from pilosa_tpu.pql import Executor
+
+    rng = np.random.default_rng(12345)
+    holder = Holder()
+    executor = Executor(holder)
+    idx = _build(rng, holder)
+
+    query = "GroupBy(Rows(a), Rows(b), limit=100)TopN(a, n=10)"
+
+    # --- warm up (compile + HBM upload) ---------------------------------
+    groups, top = executor.execute("bench", query)
+    assert len(groups) == 100 and len(top.pairs) == 10
+
+    # --- measure p50 of the full PQL path -------------------------------
+    iters = 20
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        executor.execute("bench", query)
+        times.append(time.perf_counter() - t0)
+    p50_ms = statistics.median(times) * 1e3
+
+    # --- numpy per-pair scan baseline (reference-style container walk) --
+    fa, fb = idx.field("a"), idx.field("b")
+    t0 = time.perf_counter()
+    for shard in range(SHARDS):
+        pa = fa.fragment(shard).planes[:ROWS_A]
+        pb = fb.fragment(shard).planes[:ROWS_B]
+        for i in range(ROWS_A):
+            for j in range(ROWS_B):
+                host_popcount(pa[i] & pb[j])
+        for i in range(ROWS_A):  # the TopN recount
+            host_popcount(pa[i])
+    base_ms = (time.perf_counter() - t0) * 1e3
+
+    device = jax.devices()[0].device_kind
+    print(json.dumps({
+        "metric": f"pql_groupby_topn_p50_{SHARDS}shards_{ROWS_A}x{ROWS_B} ({device})",
+        "value": round(p50_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(base_ms / p50_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,30 @@ def _popcount_i32(x):
     return lax.population_count(x).astype(jnp.int32)
 
 
+def _mark_varying(x, axes):
+    """Mark an array as varying over shard_map mesh axes, so literal-zero
+    scan carries type-match inputs traced inside shard_map. Uses the
+    current API with fallback for older jax."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
+
+def zeros_varying_like(ref, shape, dtype):
+    """Zeros carrying the same varying-manual-axes type as ``ref`` — the
+    correct scan-carry init for code that may trace inside shard_map."""
+    z = jnp.zeros(shape, dtype=dtype)
+    vma = getattr(jax.typeof(ref), "vma", frozenset())
+    return _mark_varying(z, tuple(vma)) if vma else z
+
+
+def host_popcount(x: np.ndarray) -> int:
+    """Host-side total popcount (oracle/baseline helper)."""
+    if hasattr(np, "bitwise_count"):
+        return int(np.bitwise_count(x).sum())
+    return int(np.unpackbits(np.ascontiguousarray(x).view(np.uint8)).sum())
+
+
 @jax.jit
 def plane_count(a):
     """Total set bits (reference: roaring Count / fragment popcount paths).

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pilosa_tpu.ops.bitmap import zeros_varying_like
+
 # Words per column-block of the matmul: 2048 words = 65536 bit-columns
 # -> bf16 chunk of [R, 65536] = 128KiB per row, MXU-friendly.
 BLOCK_WORDS = 2048
@@ -69,7 +71,9 @@ def pair_counts(a, b, block_words: int = BLOCK_WORDS):
         )
         return acc, None
 
-    acc0 = jnp.zeros((r1, r2), dtype=jnp.float32)
+    # Inside shard_map the inputs carry varying-manual-axes type; the scan
+    # carry must match or tracing rejects it.
+    acc0 = zeros_varying_like(a, (r1, r2), jnp.float32)
     acc, _ = lax.scan(step, acc0, (a_blocks, b_blocks))
     return acc.astype(jnp.int32)
 

@@ -1,0 +1,12 @@
+"""Distribution: shard -> device placement and collective reduces.
+
+The TPU-native replacement for the reference's cluster layer (§2.3 of
+SURVEY.md): instead of jump-hashing shards to nodes (disco/hasher.go:13)
+and scatter-gathering over HTTP (internal_client.go), shards are pinned to
+mesh devices with ``jax.sharding`` and every cross-shard reduce is an XLA
+collective (``psum``) riding ICI/DCN (SURVEY.md §5.8).
+"""
+
+from pilosa_tpu.parallel.mesh import ShardPlacement, analytics_mesh
+
+__all__ = ["ShardPlacement", "analytics_mesh"]

@@ -1,0 +1,164 @@
+"""Device-mesh shard placement and collective query reduces.
+
+Mapping from the reference's cluster model (SURVEY.md §5.7/§5.8):
+
+- reference: shard -> partition -> node via jump consistent hash
+  (disco/hasher.go:13, disco/snapshot.go:117) — here: shard i of a stacked
+  fragment tensor ``[S, ..., W]`` lives on mesh axis ``shards`` position
+  ``i % n_shard_devices`` (XLA's block sharding; deterministic, no hash
+  needed because placement is dense).
+- reference: per-call map over shard jobs + application-level reduce over
+  HTTP responses (executor.go:6449 mapReduce, internal_client.go) — here:
+  one ``shard_map``-ped kernel, reduce is ``lax.psum`` over the mesh axes,
+  riding ICI within a slice and DCN across slices.
+- the column axis (2^20 bits = 32768 words) can additionally be split over
+  a second mesh axis ``cols`` — the analog of sequence/tensor parallelism:
+  bitmap algebra is elementwise over words so it shards trivially, and the
+  GroupBy matmul contracts over the column axis with psum partial sums
+  (the classic TP matmul pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from pilosa_tpu.ops.bitmap import _popcount_i32, zeros_varying_like
+from pilosa_tpu.ops.groupby import pair_counts
+
+SHARD_AXIS = "shards"
+COL_AXIS = "cols"
+
+
+def analytics_mesh(devices: Optional[Sequence] = None,
+                   col_parallel: int = 1) -> Mesh:
+    """Build the 2D (shards, cols) mesh. ``col_parallel`` > 1 splits the
+    column/word axis — use it when single-shard latency matters more than
+    shard throughput (few big shards)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % col_parallel:
+        raise ValueError(f"{n} devices not divisible by col_parallel={col_parallel}")
+    dev_array = np.asarray(devices).reshape(n // col_parallel, col_parallel)
+    return Mesh(dev_array, (SHARD_AXIS, COL_AXIS))
+
+
+class ShardPlacement:
+    """Places stacked fragment tensors onto the mesh and runs collective
+    query kernels. The single object that replaces the reference's
+    cluster+InternalClient pair for query fan-out."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def spec(self, ndim: int) -> P:
+        """[S, ..., W]: shards on axis 0, words on the last axis."""
+        middle = [None] * (ndim - 2)
+        return P(SHARD_AXIS, *middle, COL_AXIS)
+
+    def place(self, arr) -> jax.Array:
+        arr = np.asarray(arr)
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, self.spec(arr.ndim)))
+
+    # -- collective kernels ------------------------------------------------
+
+    def count(self, planes) -> int:
+        """Global popcount of [S, W] (reference: executeCount reduce)."""
+        return int(_count(self.mesh, planes))
+
+    def intersect_count(self, a, b) -> int:
+        return int(_intersect_count(self.mesh, a, b))
+
+    def row_counts(self, planes) -> np.ndarray:
+        """[S, R, W] -> global per-row counts [R] (feeds TopN/TopK)."""
+        return np.asarray(_row_counts(self.mesh, planes))
+
+    def groupby_counts(self, a, b) -> np.ndarray:
+        """[S, G, W] x [S, R, W] -> global pairwise counts [G, R]."""
+        return np.asarray(_groupby_counts(self.mesh, a, b))
+
+    def bsi_sum_counts(self, planes, filt):
+        """[S, P, W] BSI stacks + [S, W] filter -> (count, per-plane
+        popcounts [P]) summed over all shards; host assembles the exact
+        64-bit sum as in ops/bsi.py."""
+        count, per_plane = _bsi_sum_counts(self.mesh, planes, filt)
+        return int(count), np.asarray(per_plane)
+
+
+def _specs(mesh, *in_ndims, out):
+    def spec(nd):
+        return P(SHARD_AXIS, *([None] * (nd - 2)), COL_AXIS)
+    return dict(mesh=mesh, in_specs=tuple(spec(n) for n in in_ndims),
+                out_specs=out)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _count(mesh, planes):
+    @functools.partial(_shard_map, **_specs(mesh, 2, out=P()))
+    def f(local):
+        c = jnp.sum(_popcount_i32(local))
+        return lax.psum(c, (SHARD_AXIS, COL_AXIS))
+    return f(planes)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _intersect_count(mesh, a, b):
+    @functools.partial(_shard_map, **_specs(mesh, 2, 2, out=P()))
+    def f(la, lb):
+        c = jnp.sum(_popcount_i32(la & lb))
+        return lax.psum(c, (SHARD_AXIS, COL_AXIS))
+    return f(a, b)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _row_counts(mesh, planes):
+    @functools.partial(_shard_map, **_specs(mesh, 3, out=P()))
+    def f(local):
+        c = jnp.sum(_popcount_i32(local), axis=(0, 2))
+        return lax.psum(c, (SHARD_AXIS, COL_AXIS))
+    return f(planes)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _groupby_counts(mesh, a, b):
+    @functools.partial(_shard_map, **_specs(mesh, 3, 3, out=P()))
+    def f(la, lb):
+        # Sum pair-count matrices over local shards, then all shards/cols.
+        def one(carry, ab):
+            sa, sb = ab
+            return carry + pair_counts(sa, sb), None
+        init = zeros_varying_like(la, (la.shape[1], lb.shape[1]), jnp.int32)
+        local, _ = lax.scan(one, init, (la, lb))
+        return lax.psum(local, (SHARD_AXIS, COL_AXIS))
+    return f(a, b)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _bsi_sum_counts(mesh, planes, filt):
+    from pilosa_tpu.ops.bsi import EXISTS, OFFSET, SIGN
+
+    @functools.partial(_shard_map, **_specs(mesh, 3, 2, out=(P(), P())))
+    def f(local, lfilt):
+        rows = local[:, EXISTS, :] & lfilt
+        count = jnp.sum(_popcount_i32(rows))
+        # signed per-plane counts: pos - neg, assembled host-side
+        sign = local[:, SIGN, :]
+        mags = local[:, OFFSET:, :]
+        pos = jnp.sum(_popcount_i32(mags & (rows & ~sign)[:, None, :]), axis=(0, 2))
+        neg = jnp.sum(_popcount_i32(mags & (rows & sign)[:, None, :]), axis=(0, 2))
+        return (lax.psum(count, (SHARD_AXIS, COL_AXIS)),
+                lax.psum(pos - neg, (SHARD_AXIS, COL_AXIS)))
+    return f(planes, filt)

@@ -554,23 +554,31 @@ class Executor:
             filt = self._eval(idx, filter_call, shard)
 
         # Fold fields left to right keeping group bitmaps on device
-        # (prefix planes), pruning empty groups between levels.
+        # (prefix planes), pruning empty groups between levels. The last
+        # level needs no intersection planes when there's no aggregate —
+        # the MXU pair-count matrix IS the result (the win over the
+        # reference's per-pair container walk, executor.go:3176).
         row_ids0, planes0 = per_field[0]
         group_planes = planes0[: len(row_ids0)]
         if filt is not None:
             group_planes = group_planes & filt[None, :]
         keys = [(r,) for r in row_ids0]
-        for row_ids, planes in per_field[1:]:
+        n_levels = len(per_field)
+        for level, (row_ids, planes) in enumerate(per_field[1:], start=1):
             planes = planes[: len(row_ids)]
-            #
-
-            counts = np.asarray(pair_counts(group_planes, planes))
-            g_idx, r_idx = np.nonzero(counts)
+            counts_matrix = np.asarray(pair_counts(group_planes, planes))
+            last = level == n_levels - 1
+            if last and agg_field is None:
+                g_idx, r_idx = np.nonzero(counts_matrix)
+                for g, r in zip(g_idx, r_idx):
+                    key = keys[g] + (row_ids[r],)
+                    acc.setdefault(key, [0, 0])[0] += int(counts_matrix[g, r])
+                return
+            g_idx, r_idx = np.nonzero(counts_matrix)
             if g_idx.size == 0:
                 return
-            new_planes = group_planes[g_idx] & planes[r_idx]
+            group_planes = group_planes[g_idx] & planes[r_idx]
             keys = [keys[g] + (row_ids[r],) for g, r in zip(g_idx, r_idx)]
-            group_planes = new_planes
         counts = np.asarray(B.row_counts(group_planes))
         if agg_field is not None:
             sums = self._grouped_sums(agg_field, shard, group_planes)

@@ -1,0 +1,31 @@
+"""Driver entry-point smoke tests (these rot silently otherwise)."""
+
+import pathlib
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+# Repo root holds __graft_entry__.py; don't depend on pytest's cwd.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert set(out) == {"count", "row_counts", "top_vals", "top_idx",
+                        "bsi_plane_counts", "groupby"}
+    # count equals row 0's filtered popcount
+    s, b, f = args
+    expect = g._np_popcount(np.asarray(s)[:, 0, :] & np.asarray(f))
+    assert int(out["count"]) == expect
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+def test_dryrun_multichip(n):
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(n)  # asserts internally against numpy oracle

@@ -1,0 +1,87 @@
+"""Mesh collective tests on the virtual 8-device CPU mesh.
+
+The analog of the reference's in-process multi-node cluster tests
+(test/cluster.go MustRunCluster): same kernels, N devices, results must
+equal the single-device oracle.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitmap as B
+from pilosa_tpu.ops.bsi import encode_values
+from pilosa_tpu.parallel import ShardPlacement, analytics_mesh
+
+S, R, W = 8, 6, 512  # 8 shards over up to 8 devices; W divisible by 2 and 4
+NBITS = W * 32
+
+
+@pytest.fixture(params=[1, 2, 4])
+def placement(request):
+    return ShardPlacement(analytics_mesh(col_parallel=request.param))
+
+
+def rand_stack(rng, s=S, r=None, density=0.05):
+    shape = (s, NBITS) if r is None else (s, r, NBITS)
+    raw = rng.random(shape) < density
+    packed = np.packbits(raw, axis=-1, bitorder="little")
+    return raw, packed.view("<u4").astype(np.uint32).reshape(*shape[:-1], W)
+
+
+def test_count(rng, placement):
+    raw, planes = rand_stack(rng)
+    assert placement.count(placement.place(planes)) == int(raw.sum())
+
+
+def test_intersect_count(rng, placement):
+    ra, a = rand_stack(rng)
+    rb, b = rand_stack(rng)
+    got = placement.intersect_count(placement.place(a), placement.place(b))
+    assert got == int((ra & rb).sum())
+
+
+def test_row_counts(rng, placement):
+    raw, planes = rand_stack(rng, r=R)
+    got = placement.row_counts(placement.place(planes))
+    np.testing.assert_array_equal(got, raw.sum(axis=(0, 2)))
+
+
+def test_groupby_counts(rng, placement):
+    ra, a = rand_stack(rng, r=4)
+    rb, b = rand_stack(rng, r=5)
+    got = placement.groupby_counts(placement.place(a), placement.place(b))
+    expect = np.einsum("sgw,srw->gr", ra.astype(np.int64), rb.astype(np.int64))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_bsi_sum(rng, placement):
+    depth = 12
+    stacks, filts, total, count = [], [], 0, 0
+    rng2 = np.random.default_rng(3)
+    for s in range(S):
+        cols = np.unique(rng2.integers(0, NBITS, 500))
+        vals = rng2.integers(-2000, 2000, cols.size)
+        stacks.append(encode_values(cols, vals, depth, W))
+        filt = np.zeros(NBITS, bool)
+        filt[cols[::2]] = True
+        filts.append(np.packbits(filt, bitorder="little").view("<u4"))
+        total += int(vals[::2].sum())
+        count += cols[::2].size
+    planes = np.stack(stacks)
+    filt = np.stack(filts)
+    c, per_plane = placement.bsi_sum_counts(
+        placement.place(planes), placement.place(filt))
+    got = sum(int(per_plane[k]) << k for k in range(depth))
+    assert (c, got) == (count, total)
+
+
+def test_uneven_devices_rejected():
+    with pytest.raises(ValueError):
+        analytics_mesh(col_parallel=3)  # 8 % 3 != 0
+
+
+def test_mesh_uses_all_devices():
+    mesh = analytics_mesh(col_parallel=2)
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("shards", "cols")
