@@ -1,0 +1,210 @@
+"""API facade: the single programmatic surface over holder + executor.
+
+Reference: api.go:209 (API) — ~70 methods gated by cluster state; the HTTP
+and (future) SQL layers sit on top of this, never on the holder directly.
+Here the facade also owns persistence and bulk imports (the reference
+routes those through the same object: api.go:1438 Import, :618
+ImportRoaring, :1647 ImportRoaringShard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
+from pilosa_tpu.pql.executor import Executor
+from pilosa_tpu.pql.result import result_to_json
+from pilosa_tpu.storage import load_holder_data, save_holder_data
+
+
+class API:
+    def __init__(self, path: Optional[str] = None):
+        self.holder = Holder(path)
+        self.executor = Executor(self.holder)
+        if path:
+            load_holder_data(self.holder)
+
+    # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
+
+    def create_index(self, name: str, options: Optional[dict] = None) -> Index:
+        opts = IndexOptions(
+            keys=bool((options or {}).get("keys", False)),
+            track_existence=bool((options or {}).get("trackExistence", True)),
+        )
+        return self.holder.create_index(name, opts)
+
+    def delete_index(self, name: str) -> None:
+        self.holder.delete_index(name)
+
+    def create_field(self, index: str, field: str,
+                     options: Optional[dict] = None) -> None:
+        o = dict(options or {})
+        ftype = FieldType(o.pop("type", "set"))
+        fo = FieldOptions(
+            type=ftype,
+            keys=bool(o.pop("keys", False)),
+            min=o.pop("min", None),
+            max=o.pop("max", None),
+            base=int(o.pop("base", 0)),
+            scale=int(o.pop("scale", 0)),
+            time_unit=o.pop("timeUnit", "s"),
+            time_quantum=o.pop("timeQuantum", ""),
+            ttl_seconds=int(o.pop("ttl", 0)),
+            cache_type=o.pop("cacheType", "ranked"),
+            cache_size=int(o.pop("cacheSize", 50000)),
+        )
+        self.holder.index(index).create_field(field, fo)
+        self.holder.save_schema()
+
+    def delete_field(self, index: str, field: str) -> None:
+        self.holder.index(index).delete_field(field)
+        self.holder.save_schema()
+
+    def schema(self) -> List[dict]:
+        return self.holder.schema()
+
+    # -- query (reference: api.go:209 Query) -------------------------------
+
+    def query(self, index: str, pql: str,
+              shards: Optional[Sequence[int]] = None) -> List[Any]:
+        return self.executor.execute(index, pql, shards=shards)
+
+    def query_json(self, index: str, pql: str) -> dict:
+        results = [result_to_json(r) for r in self.query(index, pql)]
+        return {"results": results}
+
+    # -- bulk import (reference: api.go:1438 Import / ImportValue) ---------
+
+    def import_bits(self, index: str, field: str,
+                    rows: Sequence[int], cols: Sequence[int],
+                    row_keys: Optional[Sequence[str]] = None,
+                    col_keys: Optional[Sequence[str]] = None,
+                    clear: bool = False) -> int:
+        """Bulk (row, col) import, translating keys when given (the analog
+        of the reference's ImportRequest with RowKeys/ColumnKeys)."""
+        idx = self.holder.index(index)
+        fld = idx.field(field)
+        if fld.options.type.is_bsi:
+            raise ValueError(
+                f"field {field!r} is int-like; use import_values")
+        if row_keys is not None:
+            m = fld.translate.create_keys(row_keys)
+            rows = [m[k] for k in row_keys]
+        if col_keys is not None:
+            m = idx.translate.create_keys(col_keys)
+            cols = [m[k] for k in col_keys]
+        if len(rows) != len(cols):
+            raise ValueError("rows and cols must be the same length")
+        changed = 0
+        if clear:
+            for r, c in zip(rows, cols):
+                changed += fld.clear_bit(int(r), int(c))
+            return changed
+        if fld.options.type in (FieldType.MUTEX, FieldType.BOOL):
+            # Per-bit path so column exclusivity holds (reference:
+            # fragment.go:1787 bulkImportMutex).
+            for r, c in zip(rows, cols):
+                changed += fld.set_bit(int(r), int(c))
+                idx.add_exists(int(c))
+            return changed
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        by_shard: Dict[int, tuple] = {}
+        for r, c in zip(rows, cols):
+            shard, pos = divmod(int(c), SHARD_WIDTH)
+            by_shard.setdefault(shard, ([], []))
+            by_shard[shard][0].append(int(r))
+            by_shard[shard][1].append(pos)
+        for shard, (rs, ps) in by_shard.items():
+            frag = fld.fragment(shard, create=True)
+            changed += frag.set_many(rs, ps)
+        if idx.options.track_existence:
+            ex = idx.field("_exists")
+            for shard, (rs, ps) in by_shard.items():
+                ex.fragment(shard, create=True).set_many([0] * len(ps), ps)
+        return changed
+
+    def import_values(self, index: str, field: str,
+                      cols: Sequence[int], values: Sequence,
+                      col_keys: Optional[Sequence[str]] = None) -> int:
+        """Bulk BSI import (reference: api.go ImportValue ->
+        fragment.importValue)."""
+        idx = self.holder.index(index)
+        fld = idx.field(field)
+        if not fld.options.type.is_bsi:
+            raise ValueError(f"field {field!r} is not an int-like field")
+        if col_keys is not None:
+            m = idx.translate.create_keys(col_keys)
+            cols = [m[k] for k in col_keys]
+        if len(cols) != len(values):
+            raise ValueError("cols and values must be the same length")
+        fld.set_values([int(c) for c in cols], values)
+        if idx.options.track_existence:
+            ex = idx.field("_exists")
+            from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+            by_shard: Dict[int, list] = {}
+            for c in cols:
+                shard, pos = divmod(int(c), SHARD_WIDTH)
+                by_shard.setdefault(shard, []).append(pos)
+            for shard, ps in by_shard.items():
+                ex.fragment(shard, create=True).set_many([0] * len(ps), ps)
+        return len(cols)
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: Dict[str, bytes], clear: bool = False) -> None:
+        """Shard-transactional roaring import (reference: api.go:1647
+        ImportRoaringShard): per view, a pilosa-roaring blob addressed as
+        row*ShardWidth + column within the shard; merged (or cleared) into
+        the fragment in one step."""
+        from pilosa_tpu.core import timeq
+        from pilosa_tpu.ops.bitmap import bits_to_plane
+        from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+        from pilosa_tpu.storage.roaring import decode_to_positions
+
+        idx = self.holder.index(index)
+        fld = idx.field(field)
+        if fld.options.type.is_bsi:
+            raise ValueError(
+                f"field {field!r} is int-like; roaring imports target "
+                "bitmap-row fields")
+        all_cols: set = set()
+        for view, blob in views.items():
+            view = view or timeq.VIEW_STANDARD
+            positions = decode_to_positions(blob)
+            rows = (positions >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
+            cols = (positions & np.uint64(SHARD_WIDTH - 1)).astype(np.int64)
+            frag = fld.fragment(shard, view, create=True)
+            for row in np.unique(rows):
+                plane = bits_to_plane(cols[rows == row], frag.words)
+                if clear:
+                    frag.clear_row_plane_bits(int(row), plane)
+                else:
+                    frag.import_row_plane(int(row), plane)
+            all_cols.update(int(c) for c in np.unique(cols))
+        if not clear and idx.options.track_existence and all_cols:
+            ex = idx.field("_exists")
+            ex.fragment(shard, create=True).set_many(
+                [0] * len(all_cols), sorted(all_cols))
+
+    # -- persistence (reference: backup/restore ctl/backup.go) -------------
+
+    def save(self) -> None:
+        save_holder_data(self.holder)
+
+    # -- info --------------------------------------------------------------
+
+    def info(self) -> dict:
+        import jax
+
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "devices": [str(d) for d in jax.devices()],
+            "indexes": sorted(self.holder.indexes),
+        }

@@ -138,6 +138,16 @@ class SetFragment:
             self.planes[s] |= plane
         self.version += 1
 
+    def clear_row_plane_bits(self, row: int, plane: np.ndarray) -> bool:
+        """Clear the bits of ``plane`` from a row; no-op (and no slot
+        allocation) when the row doesn't exist."""
+        s = self.row_index.get(row)
+        if s is None:
+            return False
+        self.planes[s] &= ~plane
+        self.version += 1
+        return True
+
     # -- host read path ----------------------------------------------------
 
     def row_plane(self, row: int) -> np.ndarray:

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.schema import FieldOptions, IndexOptions
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 class Holder:
@@ -93,7 +94,7 @@ class Holder:
             {
                 "name": idx.name,
                 "options": idx.options.to_json(),
-                "shardWidth": 1 << 20,
+                "shardWidth": SHARD_WIDTH,
                 "fields": [
                     {"name": f.name, "options": f.options.to_json()}
                     for f in idx.public_fields()

@@ -1,0 +1,5 @@
+"""HTTP serving layer (reference: http_handler.go + server/)."""
+
+from pilosa_tpu.server.http import Handler, serve
+
+__all__ = ["Handler", "serve"]

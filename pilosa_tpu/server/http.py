@@ -1,0 +1,196 @@
+"""HTTP API: the reference's REST surface on the TPU engine.
+
+Reference routes (http_handler.go:488-610):
+    POST   /index/{index}/query          PQL (http_handler.go:521)
+    POST   /index/{index}                create index
+    DELETE /index/{index}
+    POST   /index/{index}/field/{field}  create field
+    DELETE /index/{index}/field/{field}
+    GET    /schema                        (http_handler.go:500)
+    GET    /status
+    GET    /info
+    POST   /index/{i}/import              bulk bits (JSON body)
+    POST   /index/{i}/import-values       bulk BSI values (JSON body)
+
+Import bodies are JSON rather than the reference's protobuf (the wire
+codec is an L8 detail; the shard-transactional semantics match
+api.go:1647 ImportRoaringShard's one-fragment-per-request batching).
+Serving uses a stdlib ThreadingHTTPServer — queries release the GIL in
+XLA so threads suffice for the control plane; heavy data stays in the
+engine process.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from pilosa_tpu.api import API
+
+_ROUTES = [
+    ("POST", re.compile(r"^/index/([^/]+)/query$"), "post_query"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "post_field"),
+    ("DELETE", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "delete_field"),
+    ("POST", re.compile(r"^/index/([^/]+)/shard/(\d+)/import-roaring$"),
+     "post_import_roaring"),
+    ("POST", re.compile(r"^/index/([^/]+)/import$"), "post_import"),
+    ("POST", re.compile(r"^/index/([^/]+)/import-values$"), "post_import_values"),
+    ("POST", re.compile(r"^/index/([^/]+)$"), "post_index"),
+    ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
+    ("GET", re.compile(r"^/schema$"), "get_schema"),
+    ("GET", re.compile(r"^/status$"), "get_status"),
+    ("GET", re.compile(r"^/info$"), "get_info"),
+]
+
+
+class Handler(BaseHTTPRequestHandler):
+    """One handler class bound to an API instance via serve()."""
+
+    api: API  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _json_body(self) -> dict:
+        raw = self._body()
+        if not raw:
+            return {}
+        return json.loads(raw)
+
+    @staticmethod
+    def _require(body: dict, key: str):
+        """Missing request-body keys are 400s (ValueError), not the 404s
+        reserved for holder lookups (KeyError)."""
+        if key not in body:
+            raise ValueError(f"request body missing required key {key!r}")
+        return body[key]
+
+    def _send(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _dispatch(self, method: str) -> None:
+        for m, pattern, name in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(self.path.split("?", 1)[0])
+            if match:
+                try:
+                    getattr(self, name)(*match.groups())
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # pragma: no cover - last resort
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+        self._send(404, {"error": f"no route for {method} {self.path}"})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- handlers ----------------------------------------------------------
+
+    def post_query(self, index: str):
+        """PQL query; body is raw PQL or JSON {"query": "..."} (reference:
+        http_handler.go:1295 handlePostQuery)."""
+        raw = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/json":
+            q = json.loads(raw or b"{}").get("query", "")
+        else:
+            q = raw.decode()
+        self._send(200, self.api.query_json(index, q))
+
+    def post_index(self, index: str):
+        self.api.create_index(index, self._json_body().get("options"))
+        self._send(200, {"success": True})
+
+    def delete_index(self, index: str):
+        self.api.delete_index(index)
+        self._send(200, {"success": True})
+
+    def post_field(self, index: str, field: str):
+        self.api.create_field(index, field, self._json_body().get("options"))
+        self._send(200, {"success": True})
+
+    def delete_field(self, index: str, field: str):
+        self.api.delete_field(index, field)
+        self._send(200, {"success": True})
+
+    def post_import(self, index: str):
+        b = self._json_body()
+        n = self.api.import_bits(
+            index, self._require(b, "field"),
+            rows=b.get("rows", []), cols=b.get("cols", []),
+            row_keys=b.get("rowKeys"), col_keys=b.get("colKeys"),
+            clear=bool(b.get("clear", False)),
+        )
+        self._send(200, {"changed": n})
+
+    def post_import_roaring(self, index: str, shard: str):
+        """Shard-transactional roaring import (reference:
+        http_handler.go:520 + api.go:1647). Body JSON: {"field": ...,
+        "views": {view-name: base64 pilosa-roaring blob}, "clear": bool}.
+        """
+        import base64
+
+        b = self._json_body()
+        views = {v: base64.b64decode(blob)
+                 for v, blob in (b.get("views") or {}).items()}
+        self.api.import_roaring(index, self._require(b, "field"), int(shard), views,
+                                clear=bool(b.get("clear", False)))
+        self._send(200, {"success": True})
+
+    def post_import_values(self, index: str):
+        b = self._json_body()
+        n = self.api.import_values(
+            index, self._require(b, "field"), cols=b.get("cols", []),
+            values=b.get("values", []), col_keys=b.get("colKeys"),
+        )
+        self._send(200, {"imported": n})
+
+    def get_schema(self):
+        self._send(200, {"indexes": self.api.schema()})
+
+    def get_status(self):
+        self._send(200, {"state": "NORMAL", "indexes": sorted(
+            self.api.holder.indexes)})
+
+    def get_info(self):
+        self._send(200, self.api.info())
+
+
+def serve(api: API, host: str = "127.0.0.1", port: int = 10101,
+          background: bool = False) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+    """Start the HTTP server (reference: server.go:618 Open + listener).
+    With background=True returns (server, thread) for in-process use —
+    the test harness pattern (reference: test/cluster.go)."""
+    handler = type("BoundHandler", (Handler,), {"api": api})
+    srv = ThreadingHTTPServer((host, port), handler)
+    if background:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, t
+    srv.serve_forever()
+    return srv, None

@@ -1,0 +1,191 @@
+"""HTTP API tests over a live in-process server (reference pattern:
+test/cluster.go boots real servers; handler_test.go / http_handler tests).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.server import serve
+
+
+@pytest.fixture
+def server():
+    api = API()
+    srv, thread = serve(api, port=0, background=True)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base
+    srv.shutdown()
+
+
+def req(base, method, path, body=None, ctype="application/json"):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers={"Content-Type": ctype})
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_full_flow(server):
+    base = server
+    assert req(base, "POST", "/index/trips")[0] == 200
+    assert req(base, "POST", "/index/trips/field/kind")[0] == 200
+    assert req(base, "POST", "/index/trips/field/dist",
+               {"options": {"type": "int"}})[0] == 200
+
+    # raw-PQL body
+    status, out = req(base, "POST", "/index/trips/query",
+                      b"Set(1, kind=2)Set(2, kind=2)", ctype="text/plain")
+    assert status == 200 and out == {"results": [True, True]}
+    # JSON body
+    status, out = req(base, "POST", "/index/trips/query",
+                      {"query": "Count(Row(kind=2))"})
+    assert out == {"results": [2]}
+
+    # bulk imports
+    status, out = req(base, "POST", "/index/trips/import",
+                      {"field": "kind", "rows": [5, 5], "cols": [10, 11]})
+    assert out == {"changed": 2}
+    status, out = req(base, "POST", "/index/trips/import-values",
+                      {"field": "dist", "cols": [1, 2], "values": [100, -3]})
+    assert out == {"imported": 2}
+    status, out = req(base, "POST", "/index/trips/query",
+                      {"query": "Sum(field=dist)"})
+    assert out["results"][0] == {"value": 97, "count": 2}
+
+    # schema & status
+    status, out = req(base, "GET", "/schema")
+    names = {f["name"] for f in out["indexes"][0]["fields"]}
+    assert names == {"kind", "dist"}
+    status, out = req(base, "GET", "/status")
+    assert out["state"] == "NORMAL"
+
+    # deletes
+    assert req(base, "DELETE", "/index/trips/field/dist")[0] == 200
+    assert req(base, "DELETE", "/index/trips")[0] == 200
+    status, out = req(base, "GET", "/schema")
+    assert out == {"indexes": []}
+
+
+def test_keyed_flow(server):
+    base = server
+    req(base, "POST", "/index/users", {"options": {"keys": True}})
+    req(base, "POST", "/index/users/field/likes", {"options": {"keys": True}})
+    req(base, "POST", "/index/users/query",
+        b'Set("alice", likes="pizza")Set("bob", likes="pizza")',
+        ctype="text/plain")
+    _, out = req(base, "POST", "/index/users/query",
+                 {"query": 'Row(likes="pizza")'})
+    assert out == {"results": [{"keys": ["alice", "bob"]}]}
+    _, out = req(base, "POST", "/index/users/import",
+                 {"field": "likes", "rowKeys": ["sushi"], "colKeys": ["carol"]})
+    assert out == {"changed": 1}
+    _, out = req(base, "POST", "/index/users/query",
+                 {"query": "TopN(likes)"})
+    assert out["results"][0]["rows"][0] == {"key": "pizza", "count": 2}
+
+
+def test_import_roaring(server):
+    import base64
+
+    import numpy as np
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.storage.roaring import encode_positions
+
+    base = server
+    req(base, "POST", "/index/ev")
+    req(base, "POST", "/index/ev/field/f")
+    # row 3 cols {1, 2}, row 5 col {9} in shard 1 (fragment addressing:
+    # row*ShardWidth + col)
+    pos = np.array([3 * SHARD_WIDTH + 1, 3 * SHARD_WIDTH + 2,
+                    5 * SHARD_WIDTH + 9], dtype=np.uint64)
+    blob = base64.b64encode(encode_positions(pos)).decode()
+    status, out = req(base, "POST", "/index/ev/shard/1/import-roaring",
+                      {"field": "f", "views": {"standard": blob}})
+    assert out == {"success": True}
+    _, out = req(base, "POST", "/index/ev/query", {"query": "Row(f=3)"})
+    assert out["results"][0]["columns"] == [SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+    _, out = req(base, "POST", "/index/ev/query", {"query": "Count(All())"})
+    assert out["results"][0] == 3
+    # clear=true removes bits
+    clear_pos = np.array([3 * SHARD_WIDTH + 1], dtype=np.uint64)
+    blob = base64.b64encode(encode_positions(clear_pos)).decode()
+    req(base, "POST", "/index/ev/shard/1/import-roaring",
+        {"field": "f", "views": {"standard": blob}, "clear": True})
+    _, out = req(base, "POST", "/index/ev/query", {"query": "Row(f=3)"})
+    assert out["results"][0]["columns"] == [SHARD_WIDTH + 2]
+
+
+def test_import_guards(server):
+    base = server
+    req(base, "POST", "/index/g")
+    req(base, "POST", "/index/g/field/m", {"options": {"type": "mutex"}})
+    req(base, "POST", "/index/g/field/n", {"options": {"type": "int"}})
+    # mutex exclusivity holds through the bulk path
+    req(base, "POST", "/index/g/import",
+        {"field": "m", "rows": [3], "cols": [10]})
+    req(base, "POST", "/index/g/import",
+        {"field": "m", "rows": [5], "cols": [10]})
+    _, out = req(base, "POST", "/index/g/query", {"query": "Row(m=3)"})
+    assert out["results"][0]["columns"] == []
+    _, out = req(base, "POST", "/index/g/query", {"query": "Row(m=5)"})
+    assert out["results"][0]["columns"] == [10]
+    # set-style imports into BSI fields rejected, not blackholed
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/g/import",
+            {"field": "n", "rows": [0], "cols": [1]})
+    assert e.value.code == 400
+    # value/col length mismatch rejected
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/g/import-values",
+            {"field": "n", "cols": [1, 2, 3], "values": [100]})
+    assert e.value.code == 400
+    # missing required body key is a 400, not 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/g/import", {})
+    assert e.value.code == 400
+    # clear of a never-set row via roaring doesn't 500 or allocate
+    import base64
+
+    import numpy as np
+
+    from pilosa_tpu.storage.roaring import encode_positions
+    blob = base64.b64encode(encode_positions(
+        np.array([999 * (1 << 20) + 5], dtype=np.uint64))).decode()
+    req(base, "POST", "/index/g/field/s")
+    status, out = req(base, "POST", "/index/g/shard/0/import-roaring",
+                      {"field": "s", "views": {"standard": blob}, "clear": True})
+    assert out == {"success": True}
+    # truncated roaring blob is a 400 (RoaringError), not a 500
+    import struct
+    bad = base64.b64encode(
+        struct.pack("<II", 12348, 1) + struct.pack("<QHH", 0, 3, 10)
+        + struct.pack("<I", 24) + b"\xff\xff").decode()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/g/shard/0/import-roaring",
+            {"field": "s", "views": {"standard": bad}})
+    assert e.value.code == 400
+
+
+def test_errors(server):
+    base = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/nope/query", {"query": "Count(All())"})
+    assert e.value.code == 404
+    req(base, "POST", "/index/i")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/i/query", {"query": "Row(f="})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "GET", "/not-a-route")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/i/query", b"\xff\xfe not json",
+            ctype="application/json")
+    assert e.value.code in (400, 500)
