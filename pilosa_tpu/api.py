@@ -73,6 +73,14 @@ class API:
               shards: Optional[Sequence[int]] = None) -> List[Any]:
         return self.executor.execute(index, pql, shards=shards)
 
+    def sql(self, query: str):
+        """Execute a SQL statement (reference: server/sql.go:17 execSQL).
+        Returns a pilosa_tpu.sql.SQLResult."""
+        if not hasattr(self, "_sql_engine"):
+            from pilosa_tpu.sql import SQLEngine
+            self._sql_engine = SQLEngine(self)
+        return self._sql_engine.query(query)
+
     def query_json(self, index: str, pql: str) -> dict:
         results = [result_to_json(r) for r in self.query(index, pql)]
         return {"results": results}

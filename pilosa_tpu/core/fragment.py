@@ -148,6 +148,16 @@ class SetFragment:
         self.version += 1
         return True
 
+    def clear_plane(self, plane: np.ndarray) -> None:
+        """Clear the columns of ``plane`` from every row (record deletion,
+        reference: executor.go:9050 executeDeleteRecords clearing each
+        fragment)."""
+        n = len(self.row_ids)
+        if n == 0:
+            return
+        self.planes[:n] &= ~plane
+        self.version += 1
+
     # -- host read path ----------------------------------------------------
 
     def row_plane(self, row: int) -> np.ndarray:
@@ -259,6 +269,12 @@ class BSIFragment:
 
     def exists_plane(self) -> np.ndarray:
         return self.planes[bsiops.EXISTS]
+
+    def clear_plane(self, plane: np.ndarray) -> None:
+        """Clear the columns of ``plane`` from every BSI plane (record
+        deletion, reference: executor.go:9050 executeDeleteRecords)."""
+        self.planes &= ~plane[None, :]
+        self.version += 1
 
     def device_planes(self) -> jax.Array:
         if self._device is None or self._device_version != self.version:

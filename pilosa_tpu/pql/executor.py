@@ -754,7 +754,37 @@ class Executor:
             return self._execute_clear_row(idx, call)
         if name == "Store":
             return self._execute_store(idx, call)
+        if name == "Delete":
+            return self._execute_delete(idx, call)
         raise PQLError(f"write call {name!r} not implemented")
+
+    def _execute_delete(self, idx: Index, call: Call) -> int:
+        """Delete the records selected by the child bitmap: clear their
+        columns from every fragment of every field, the existence field,
+        and all BSI planes (reference: executor.go:9050
+        executeDeleteRecords). Returns the number of records deleted."""
+        if not call.children:
+            raise PQLError("Delete requires a bitmap child")
+        deleted = 0
+        for shard in self._shards(idx, None):
+            plane = np.asarray(self._eval(idx, call.children[0], shard))
+            if idx.existence is not None:
+                # count only records that actually exist (reference:
+                # executeDeleteRecords intersects the existence row)
+                plane = plane & np.asarray(self._existence(idx, shard))
+            n = int(B.plane_to_bits(plane).size)
+            if n == 0:
+                continue
+            deleted += n
+            for field in idx.fields.values():
+                for view_frags in field.views.values():
+                    frag = view_frags.get(shard)
+                    if frag is not None:
+                        frag.clear_plane(plane)
+                bsi = field.bsi.get(shard)
+                if bsi is not None:
+                    bsi.clear_plane(plane)
+        return deleted
 
     def _execute_set(self, idx: Index, call: Call) -> bool:
         col = call.arg("_col")

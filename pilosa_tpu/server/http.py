@@ -40,6 +40,7 @@ _ROUTES = [
     ("POST", re.compile(r"^/index/([^/]+)/import-values$"), "post_import_values"),
     ("POST", re.compile(r"^/index/([^/]+)$"), "post_index"),
     ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
+    ("POST", re.compile(r"^/sql$"), "post_sql"),
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/info$"), "get_info"),
@@ -121,6 +122,12 @@ class Handler(BaseHTTPRequestHandler):
         else:
             q = raw.decode()
         self._send(200, self.api.query_json(index, q))
+
+    def post_sql(self):
+        """SQL query; body is the raw SQL text (reference:
+        http_handler.go:536 POST /sql -> :1440 handlePostSQL)."""
+        # SQLError subclasses ValueError -> _dispatch maps it to a 400
+        self._send(200, self.api.sql(self._body().decode()).to_json())
 
     def post_index(self, index: str):
         self.api.create_index(index, self._json_body().get("options"))

@@ -1,0 +1,13 @@
+"""SQL3 engine: a SQL dialect over the PQL/kernel engine.
+
+Reference: sql3/ — hand-written parser (sql3/parser/parser.go), planner
+compiling to PlanOperator trees (sql3/planner/executionplanner.go:32) with
+PQL-bridging operators (oppqltablescan.go, oppqlaggregate.go,
+oppqlgroupby.go, oppqldistinctscan.go). Here the planner lowers WHERE
+trees to PQL filter calls (kernel-executed on TPU) and falls back to a
+host row-stream filter only for expressions with no bitmap form.
+"""
+
+from pilosa_tpu.sql.engine import SQLEngine, SQLResult
+
+__all__ = ["SQLEngine", "SQLResult"]

@@ -1,0 +1,257 @@
+"""SQL engine entry: parse -> plan -> execute -> result.
+
+Reference: server/sql.go:17 execSQL + sql3/planner/executionplanner.go.
+The JSON result shape matches the reference's POST /sql response
+(http_handler.go:1440): {"schema": {"fields": [...]}, "data": [...]}.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import io
+import time
+from typing import Any, List, Optional
+
+from pilosa_tpu.core.schema import FieldType
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.parser import parse_statement
+from pilosa_tpu.sql.plan import PlanOp, Schema, StaticOp, eval_expr
+from pilosa_tpu.sql.planner import Planner
+from pilosa_tpu.sql.types import column_to_field_options, field_to_sql_type, \
+    id_sql_type
+
+
+@dataclasses.dataclass
+class SQLResult:
+    schema: Schema
+    data: List[List[Any]]
+    changed: int = 0  # rows affected by DML
+    exec_ms: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "schema": {"fields": [{"name": n, "base-type": t.lower()}
+                                  for n, t in self.schema]},
+            "data": self.data,
+            "rows-affected": self.changed,
+            "execution-time": int(self.exec_ms * 1000),  # µs like the ref
+        }
+
+
+class SQLEngine:
+    def __init__(self, api):
+        self.api = api
+        self.planner = Planner(api)
+
+    def query(self, sql: str) -> SQLResult:
+        t0 = time.monotonic()
+        stmt = parse_statement(sql)
+        res = self._dispatch(stmt)
+        res.exec_ms = (time.monotonic() - t0) * 1000
+        return res
+
+    def compile_plan(self, sql: str) -> Optional[PlanOp]:
+        """Compile without executing (reference: server.go:1448
+        CompileExecutionPlan, used by tests and EXPLAIN-style tooling)."""
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.SelectStatement):
+            return self.planner.plan_select(stmt)
+        return None
+
+    # -- statement dispatch ---------------------------------------------------
+
+    def _dispatch(self, stmt) -> SQLResult:
+        if isinstance(stmt, ast.SelectStatement):
+            op = self.planner.plan_select(stmt)
+            return SQLResult(schema=op.schema, data=[list(r) for r in op.rows()])
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._drop_table(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter_table(stmt)
+        if isinstance(stmt, ast.InsertStatement):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.BulkInsert):
+            return self._bulk_insert(stmt)
+        if isinstance(stmt, ast.DeleteStatement):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            return self._show_tables()
+        if isinstance(stmt, ast.ShowColumns):
+            return self._show_columns(stmt.table)
+        if isinstance(stmt, ast.ShowDatabases):
+            return SQLResult(schema=[("name", "STRING")], data=[])
+        raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- DDL ------------------------------------------------------------------
+
+    def _create_table(self, ct: ast.CreateTable) -> SQLResult:
+        holder = self.api.holder
+        if ct.name in holder.indexes:
+            if ct.if_not_exists:
+                return SQLResult(schema=[], data=[])
+            raise SQLError(f"table {ct.name!r} already exists")
+        id_cols = [c for c in ct.columns if c.name == "_id"]
+        if not id_cols:
+            raise SQLError("CREATE TABLE requires an _id column")
+        if id_cols[0].type not in ("ID", "STRING"):
+            raise SQLError("_id must be ID or STRING")
+        self.api.create_index(ct.name, {"keys": id_cols[0].type == "STRING"})
+        try:
+            for c in ct.columns:
+                if c.name == "_id":
+                    continue
+                opts = column_to_field_options(c)
+                self.api.holder.index(ct.name).create_field(c.name, opts)
+        except Exception:
+            self.api.delete_index(ct.name)
+            raise
+        return SQLResult(schema=[], data=[])
+
+    def _drop_table(self, d: ast.DropTable) -> SQLResult:
+        if d.name not in self.api.holder.indexes:
+            if d.if_exists:
+                return SQLResult(schema=[], data=[])
+            raise SQLError(f"table {d.name!r} does not exist")
+        self.api.delete_index(d.name)
+        return SQLResult(schema=[], data=[])
+
+    def _alter_table(self, a: ast.AlterTable) -> SQLResult:
+        idx = self.api.holder.index(a.name)
+        if a.add is not None:
+            idx.create_field(a.add.name, column_to_field_options(a.add))
+        elif a.drop is not None:
+            idx.delete_field(a.drop)
+        return SQLResult(schema=[], data=[])
+
+    # -- DML ------------------------------------------------------------------
+
+    def _insert(self, ins: ast.InsertStatement) -> SQLResult:
+        idx = self.api.holder.index(ins.table)
+        # default column list follows declared order (fields dict preserves
+        # creation order), not the sorted public_fields() view
+        cols = ins.columns or (
+            ["_id"] + [n for n in idx.fields if not n.startswith("_")])
+        if "_id" not in cols:
+            raise SQLError("INSERT requires the _id column")
+        n = 0
+        for row_exprs in ins.rows:
+            if len(row_exprs) != len(cols):
+                raise SQLError("INSERT value count does not match column list")
+            values = {c: eval_expr(e, {}) for c, e in zip(cols, row_exprs)}
+            self._upsert_record(idx, values, replace=ins.replace)
+            n += 1
+        return SQLResult(schema=[], data=[], changed=n)
+
+    def _upsert_record(self, idx, values: dict, replace: bool = False) -> None:
+        ex = self.api.executor
+        col = ex._col_id(idx, values["_id"], create=True)
+        idx.add_exists(col)
+        for name, v in values.items():
+            if name == "_id":
+                continue
+            field = idx.field(name)
+            t = field.options.type
+            if v is None:
+                continue
+            if t.is_bsi:
+                field.set_value(col, v)
+            elif t == FieldType.BOOL:
+                field.set_bool(col, bool(v))
+            else:
+                vals = v if isinstance(v, list) else [v]
+                if replace and t not in (FieldType.MUTEX, FieldType.BOOL):
+                    # REPLACE resets set-valued columns; mutex/bool clear
+                    # themselves in set_bit (reference: sql3 REPLACE INTO).
+                    shard, pos = divmod(col, _shard_width())
+                    for frags in field.views.values():
+                        frag = frags.get(shard)
+                        if frag is not None:
+                            frag.clear_column(pos)
+                for item in vals:
+                    row = ex._row_id(field, item, create=True)
+                    field.set_bit(row, col)
+
+    def _bulk_insert(self, bi: ast.BulkInsert) -> SQLResult:
+        """CSV bulk load (reference: sql3 BULK INSERT with MAP ordinals,
+        planner_bulkinsert.go; FORMAT 'CSV' INPUT 'FILE'/'STREAM')."""
+        idx = self.api.holder.index(bi.table)
+        fmt = str(bi.options.get("FORMAT", "CSV")).upper()
+        if fmt != "CSV":
+            raise SQLError(f"BULK INSERT format {fmt!r} not supported")
+        inp = str(bi.options.get("INPUT", "FILE")).upper()
+        cols = bi.columns
+        if len(cols) != len(bi.map_defs):
+            raise SQLError("BULK INSERT MAP count must match column list")
+        if inp == "STREAM":
+            f = io.StringIO(bi.source)
+        else:
+            f = open(bi.source, newline="")
+        n = 0
+        with f:
+            rows = iter(csv.reader(f))
+            if bi.options.get("HEADER_ROW"):
+                next(rows, None)
+            limit = bi.options.get("ROWSLIMIT")
+            for rec in rows:
+                if limit is not None and n >= int(limit):
+                    break
+                values = {}
+                for cname, (src, typ) in zip(cols, bi.map_defs):
+                    raw = rec[int(src)]
+                    values[cname] = _coerce(raw, typ)
+                self._upsert_record(idx, values)
+                n += 1
+        return SQLResult(schema=[], data=[], changed=n)
+
+    def _delete(self, d: ast.DeleteStatement) -> SQLResult:
+        from pilosa_tpu.pql.ast import Call, Query
+        idx = self.api.holder.index(d.table)
+        if d.where is None:
+            target = Call("All")
+        else:
+            fc, host = self.planner._split_filter(idx, d.where)
+            if host is not None:
+                raise SQLError("DELETE WHERE must be expressible as a filter")
+            target = fc or Call("All")
+        n = self.api.executor.execute(
+            d.table, Query([Call("Delete", children=[target])]))[0]
+        return SQLResult(schema=[], data=[], changed=int(n))
+
+    # -- SHOW -----------------------------------------------------------------
+
+    def _show_tables(self) -> SQLResult:
+        rows = [[name] for name in sorted(self.api.holder.indexes)]
+        return SQLResult(schema=[("name", "STRING")], data=rows)
+
+    def _show_columns(self, table: str) -> SQLResult:
+        idx = self.api.holder.index(table)
+        rows = [["_id", id_sql_type(idx.options.keys)]]
+        for f in idx.public_fields():
+            rows.append([f.name, field_to_sql_type(f.options)])
+        return SQLResult(schema=[("name", "STRING"), ("type", "STRING")],
+                         data=rows)
+
+
+def _coerce(raw: str, typ: str):
+    typ = typ.upper()
+    if raw == "" and typ != "STRING":
+        return None
+    if typ in ("ID", "INT"):
+        return int(raw)
+    if typ == "DECIMAL":
+        return float(raw)
+    if typ == "BOOL":
+        return raw.strip().lower() in ("1", "true", "t", "yes")
+    if typ in ("IDSET", "STRINGSET"):
+        parts = [p for p in raw.split(";") if p]
+        return [int(p) for p in parts] if typ == "IDSET" else parts
+    return raw  # STRING, TIMESTAMP pass through
+
+
+def _shard_width() -> int:
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    return SHARD_WIDTH

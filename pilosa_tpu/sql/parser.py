@@ -1,0 +1,468 @@
+"""Recursive-descent SQL parser.
+
+Reference: sql3/parser/parser.go (hand-written recursive descent; same
+approach, new grammar code). Entry point: ``parse_statement``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError, Token, tokenize
+
+SQL_TYPES = {"ID", "STRING", "IDSET", "STRINGSET", "INT", "DECIMAL",
+             "TIMESTAMP", "BOOL", "IDSETQ", "STRINGSETQ", "VARCHAR"}
+
+AGG_FUNCS = {"COUNT", "SUM", "AVG", "MIN", "MAX", "PERCENTILE", "CORR"}
+
+
+class Parser:
+    def __init__(self, src: str):
+        self.toks: List[Token] = tokenize(src)
+        self.i = 0
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "EOF":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "KEYWORD" and t.value in kws
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.value in ops
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def accept_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SQLError(f"expected {kw}, got {self.peek().value!r}")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SQLError(f"expected {op!r}, got {self.peek().value!r}")
+
+    def ident(self) -> str:
+        t = self.next()
+        # allow non-reserved keywords as identifiers (MIN/MAX/SIZE/COMMENT...)
+        if t.kind not in ("IDENT", "KEYWORD"):
+            raise SQLError(f"expected identifier, got {t.value!r}")
+        return t.value if t.kind == "IDENT" else t.value.lower()
+
+    # -- statements ----------------------------------------------------------
+
+    def parse_statement(self):
+        if self.at_kw("SELECT"):
+            stmt = self.select()
+        elif self.at_kw("CREATE"):
+            stmt = self.create_table()
+        elif self.at_kw("DROP"):
+            stmt = self.drop_table()
+        elif self.at_kw("ALTER"):
+            stmt = self.alter_table()
+        elif self.at_kw("INSERT", "REPLACE"):
+            stmt = self.insert()
+        elif self.at_kw("BULK"):
+            stmt = self.bulk_insert()
+        elif self.at_kw("DELETE"):
+            stmt = self.delete()
+        elif self.at_kw("SHOW"):
+            stmt = self.show()
+        else:
+            raise SQLError(f"unexpected token {self.peek().value!r}")
+        self.accept_op(";")
+        if self.peek().kind != "EOF":
+            raise SQLError(f"trailing input at {self.peek().value!r}")
+        return stmt
+
+    def select(self) -> ast.SelectStatement:
+        self.expect_kw("SELECT")
+        s = ast.SelectStatement(items=[])
+        if self.accept_kw("TOP"):
+            self.expect_op("(")
+            s.top = int(self.next().value)
+            self.expect_op(")")
+        if self.accept_kw("DISTINCT"):
+            s.distinct = True
+        while True:
+            s.items.append(self.select_item())
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("FROM"):
+            s.table = self.ident()
+            if self.accept_kw("AS"):
+                s.table_alias = self.ident()
+            elif self.peek().kind == "IDENT":
+                s.table_alias = self.ident()
+        if self.accept_kw("WHERE"):
+            s.where = self.expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            while True:
+                s.group_by.append(self.expr())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("HAVING"):
+            s.having = self.expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.accept_kw("DESC"):
+                    desc = True
+                else:
+                    self.accept_kw("ASC")
+                s.order_by.append(ast.OrderTerm(e, desc))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("LIMIT"):
+            s.limit = int(self.next().value)
+        if self.accept_kw("OFFSET"):
+            s.offset = int(self.next().value)
+        return s
+
+    def select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        e = self.expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind == "IDENT":
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    def create_table(self) -> ast.CreateTable:
+        self.expect_kw("CREATE")
+        self.expect_kw("TABLE")
+        ine = False
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")  # NOT is a keyword
+            self.expect_kw("EXISTS")
+            ine = True
+        name = self.ident()
+        self.expect_op("(")
+        cols = [self.column_def()]
+        while self.accept_op(","):
+            cols.append(self.column_def())
+        self.expect_op(")")
+        ct = ast.CreateTable(name=name, columns=cols, if_not_exists=ine)
+        while True:
+            if self.accept_kw("COMMENT"):
+                ct.comment = self.next().value
+            elif self.accept_kw("KEYPARTITIONS"):
+                ct.key_partitions = int(self.next().value)
+            elif self.accept_kw("WITH"):
+                continue
+            else:
+                break
+        return ct
+
+    def column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        t = self.next()
+        typ = t.value.upper()
+        if typ not in SQL_TYPES:
+            raise SQLError(f"unknown type {t.value!r} for column {name}")
+        if typ == "VARCHAR":
+            typ = "STRING"
+        cd = ast.ColumnDef(name=name, type=typ)
+        if self.accept_op("("):
+            cd.type_arg = int(self.next().value)
+            self.expect_op(")")
+        # constraints in any order
+        while True:
+            if self.accept_kw("MIN"):
+                cd.min = self._signed_int()
+            elif self.accept_kw("MAX"):
+                cd.max = self._signed_int()
+            elif self.accept_kw("TIMEUNIT"):
+                cd.time_unit = self.next().value
+            elif self.accept_kw("TIMEQUANTUM"):
+                cd.time_quantum = self.next().value
+            elif self.accept_kw("TTL"):
+                cd.ttl = self.next().value
+            elif self.accept_kw("CACHETYPE"):
+                cd.cache_type = self.ident()
+                if self.accept_kw("SIZE"):
+                    cd.cache_size = int(self.next().value)
+            else:
+                break
+        return cd
+
+    def _signed_int(self) -> int:
+        neg = self.accept_op("-")
+        v = int(self.next().value)
+        return -v if neg else v
+
+    def drop_table(self) -> ast.DropTable:
+        self.expect_kw("DROP")
+        self.expect_kw("TABLE")
+        ife = False
+        if self.accept_kw("IF"):
+            self.expect_kw("EXISTS")
+            ife = True
+        return ast.DropTable(name=self.ident(), if_exists=ife)
+
+    def alter_table(self) -> ast.AlterTable:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        name = self.ident()
+        if self.accept_kw("ADD"):
+            self.accept_kw("COLUMN")
+            return ast.AlterTable(name=name, add=self.column_def())
+        if self.accept_kw("DROP"):
+            self.accept_kw("COLUMN")
+            return ast.AlterTable(name=name, drop=self.ident())
+        raise SQLError("ALTER TABLE supports ADD/DROP COLUMN")
+
+    def insert(self) -> ast.InsertStatement:
+        replace = self.accept_kw("REPLACE")
+        if not replace:
+            self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.ident()
+        cols: List[str] = []
+        if self.accept_op("("):
+            cols.append(self.ident())
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("VALUES")
+        rows: List[List[ast.Expr]] = []
+        while True:
+            self.expect_op("(")
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ast.InsertStatement(table=table, columns=cols, rows=rows,
+                                   replace=replace)
+
+    def bulk_insert(self) -> ast.BulkInsert:
+        self.expect_kw("BULK")
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.ident()
+        cols: List[str] = []
+        if self.accept_op("("):
+            cols.append(self.ident())
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("MAP")
+        self.expect_op("(")
+        maps = []
+        while True:
+            src = self.next().value  # ordinal or json path
+            t = self.next().value.upper()
+            maps.append((src, t))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        self.expect_kw("FROM")
+        source = self.next().value
+        opts: dict = {}
+        if self.accept_kw("WITH"):
+            while True:
+                t = self.peek()
+                if t.kind in ("IDENT", "KEYWORD") and t.value.upper() in (
+                        "FORMAT", "INPUT", "HEADER_ROW", "BATCHSIZE",
+                        "ROWSLIMIT", "ALLOW_MISSING_VALUES"):
+                    key = self.next().value.upper()
+                    if key in ("HEADER_ROW", "ALLOW_MISSING_VALUES"):
+                        opts[key] = True
+                    else:
+                        opts[key] = self.next().value
+                else:
+                    break
+        return ast.BulkInsert(table=table, columns=cols, map_defs=maps,
+                              source=source, options=opts)
+
+    def delete(self) -> ast.DeleteStatement:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.ident()
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.expr()
+        return ast.DeleteStatement(table=table, where=where)
+
+    def show(self):
+        self.expect_kw("SHOW")
+        if self.accept_kw("TABLES"):
+            return ast.ShowTables()
+        if self.accept_kw("DATABASES"):
+            return ast.ShowDatabases()
+        if self.accept_kw("COLUMNS"):
+            self.expect_kw("FROM")
+            return ast.ShowColumns(table=self.ident())
+        raise SQLError("SHOW supports TABLES / DATABASES / COLUMNS FROM t")
+
+    # -- expressions (precedence climbing) -----------------------------------
+
+    def expr(self) -> ast.Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Expr:
+        left = self.and_expr()
+        while self.accept_kw("OR"):
+            left = ast.Binary("OR", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.Expr:
+        left = self.not_expr()
+        while self.accept_kw("AND"):
+            left = ast.Binary("AND", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.Expr:
+        if self.accept_kw("NOT"):
+            return ast.Unary("NOT", self.not_expr())
+        return self.comparison()
+
+    def comparison(self) -> ast.Expr:
+        left = self.additive()
+        t = self.peek()
+        if t.kind == "OP" and t.value in ("=", "!=", "<", "<=", ">", ">="):
+            op = self.next().value
+            return ast.Binary(op, left, self.additive())
+        if self.at_kw("IS"):
+            self.next()
+            negated = self.accept_kw("NOT")
+            self.expect_kw("NULL")
+            return ast.IsNull(left, negated=negated)
+        negated = False
+        if self.at_kw("NOT") and self.peek(1).value in ("IN", "BETWEEN", "LIKE"):
+            self.next()
+            negated = True
+        if self.accept_kw("IN"):
+            self.expect_op("(")
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return ast.InList(left, items, negated=negated)
+        if self.accept_kw("BETWEEN"):
+            low = self.additive()
+            self.expect_kw("AND")
+            high = self.additive()
+            return ast.Between(left, low, high, negated=negated)
+        if self.accept_kw("LIKE"):
+            pat = self.next()
+            if pat.kind != "STRING":
+                raise SQLError("LIKE requires a string pattern")
+            return ast.Like(left, pat.value, negated=negated)
+        return left
+
+    def additive(self) -> ast.Expr:
+        left = self.multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            left = ast.Binary(op, left, self.multiplicative())
+        return left
+
+    def multiplicative(self) -> ast.Expr:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.Binary(op, left, self.unary())
+        return left
+
+    def unary(self) -> ast.Expr:
+        if self.accept_op("-"):
+            return ast.Unary("-", self.unary())
+        return self.primary()
+
+    def primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            v = float(t.value) if "." in t.value else int(t.value)
+            return ast.Literal(v)
+        if t.kind == "STRING":
+            self.next()
+            return ast.Literal(t.value)
+        if self.at_kw("TRUE"):
+            self.next()
+            return ast.Literal(True)
+        if self.at_kw("FALSE"):
+            self.next()
+            return ast.Literal(False)
+        if self.at_kw("NULL"):
+            self.next()
+            return ast.Literal(None)
+        if self.at_op("["):  # set literal ['a','b'] (bulk/insert values)
+            self.next()
+            items = []
+            if not self.at_op("]"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+            self.expect_op("]")
+            vals = []
+            for it in items:
+                if not isinstance(it, ast.Literal):
+                    raise SQLError("set literals must contain literals")
+                vals.append(it.value)
+            return ast.Literal(vals)
+        if self.at_op("("):
+            self.next()
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        # COUNT/MIN/MAX are keywords but also functions
+        if t.kind in ("IDENT", "KEYWORD"):
+            name = self.next().value
+            if self.at_op("("):
+                self.next()
+                fname = name.upper()
+                distinct = False
+                args: List[ast.Expr] = []
+                if self.at_op("*"):
+                    self.next()
+                    args.append(ast.Star())
+                elif not self.at_op(")"):
+                    if self.accept_kw("DISTINCT"):
+                        distinct = True
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op(")")
+                return ast.FuncCall(fname, args, distinct=distinct)
+            if self.accept_op("."):
+                col = self.ident()
+                return ast.ColumnRef(col, table=name)
+            if t.kind == "KEYWORD" and name not in (
+                    "MIN", "MAX", "COMMENT", "SIZE", "TOP"):
+                raise SQLError(f"unexpected keyword {name!r} in expression")
+            return ast.ColumnRef(name if t.kind == "IDENT" else name.lower())
+        raise SQLError(f"unexpected token {t.value!r} in expression")
+
+
+def parse_statement(src: str):
+    return Parser(src).parse_statement()

@@ -1,0 +1,378 @@
+"""Plan operators: row-stream iterators over kernel-backed scans.
+
+Reference: sql3/planner op*.go — each operator is an iterator with a
+schema; PQL-bridging operators (oppqltablescan.go, oppqlgroupby.go,
+oppqlaggregate.go, oppqldistinctscan.go) launch engine queries, host
+operators (opfilter, opproject, oporderby, optop, opdistinct) transform
+the stream. Here the PQL-bridging ops launch TPU kernels through the
+executor; host ops are plain Python over the (small) result stream.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+
+Schema = List[Tuple[str, str]]  # (column name, SQL type)
+Row = List[Any]
+
+
+class PlanOp:
+    schema: Schema = []
+
+    def rows(self) -> Iterator[Row]:
+        raise NotImplementedError
+
+    def child_ops(self) -> List["PlanOp"]:
+        return []
+
+    def plan_json(self) -> dict:
+        return {"op": type(self).__name__,
+                "schema": [{"name": n, "type": t} for n, t in self.schema],
+                "children": [c.plan_json() for c in self.child_ops()]}
+
+
+class StaticOp(PlanOp):
+    """Fixed row set (SHOW ..., DDL acks)."""
+
+    def __init__(self, schema: Schema, data: Sequence[Row]):
+        self.schema = schema
+        self._data = list(data)
+
+    def rows(self) -> Iterator[Row]:
+        return iter(self._data)
+
+
+class CallbackOp(PlanOp):
+    """Rows produced by a thunk at iteration time (PQL-bridging ops use
+    this to defer kernel launches until the plan actually runs)."""
+
+    def __init__(self, schema: Schema, thunk: Callable[[], Iterator[Row]],
+                 name: str = "CallbackOp"):
+        self.schema = schema
+        self._thunk = thunk
+        self._name = name
+
+    def rows(self) -> Iterator[Row]:
+        return iter(self._thunk())
+
+    def plan_json(self) -> dict:
+        d = super().plan_json()
+        d["op"] = self._name
+        return d
+
+
+# -- host-side expression evaluation ----------------------------------------
+
+def _like_to_regex(pattern: str) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.IGNORECASE)
+
+
+def eval_expr(expr: ast.Expr, env: Dict[str, Any]) -> Any:
+    """Evaluate an expression against a row environment (column -> value).
+
+    Mirrors the reference's host-side expression ops (sql3/planner
+    expression.go); used for projections and the non-lowerable WHERE
+    fallback."""
+    if isinstance(expr, ast.Literal):
+        return expr.value
+    if isinstance(expr, ast.ColumnRef):
+        if expr.name not in env:
+            raise SQLError(f"unknown column {expr.name!r}")
+        return env[expr.name]
+    if isinstance(expr, ast.Unary):
+        v = eval_expr(expr.operand, env)
+        if expr.op == "NOT":
+            return None if v is None else (not _truthy(v))
+        if expr.op == "-":
+            return None if v is None else -v
+        raise SQLError(f"bad unary op {expr.op}")
+    if isinstance(expr, ast.Binary):
+        if expr.op == "AND":
+            l = eval_expr(expr.left, env)
+            if l is not None and not _truthy(l):
+                return False
+            r = eval_expr(expr.right, env)
+            return _truthy(l) and _truthy(r) if None not in (l, r) else None
+        if expr.op == "OR":
+            l = eval_expr(expr.left, env)
+            if l is not None and _truthy(l):
+                return True
+            r = eval_expr(expr.right, env)
+            return _truthy(l) or _truthy(r) if None not in (l, r) else None
+        l = eval_expr(expr.left, env)
+        r = eval_expr(expr.right, env)
+        if expr.op in ("=", "!=", "<", "<=", ">", ">="):
+            if l is None or r is None:
+                return None
+            if isinstance(l, list) or isinstance(r, list):
+                eq = set(l if isinstance(l, list) else [l]) == set(
+                    r if isinstance(r, list) else [r])
+                return eq if expr.op == "=" else (not eq)
+            return {"=": l == r, "!=": l != r, "<": l < r, "<=": l <= r,
+                    ">": l > r, ">=": l >= r}[expr.op]
+        if l is None or r is None:
+            return None
+        if expr.op == "+":
+            return l + r
+        if expr.op == "-":
+            return l - r
+        if expr.op == "*":
+            return l * r
+        if expr.op == "/":
+            return l // r if isinstance(l, int) and isinstance(r, int) else l / r
+        if expr.op == "%":
+            return l % r
+        raise SQLError(f"bad binary op {expr.op}")
+    if isinstance(expr, ast.InList):
+        v = eval_expr(expr.operand, env)
+        if v is None:
+            return None
+        hit = v in [eval_expr(it, env) for it in expr.items]
+        return (not hit) if expr.negated else hit
+    if isinstance(expr, ast.Between):
+        v = eval_expr(expr.operand, env)
+        if v is None:
+            return None
+        lo, hi = eval_expr(expr.low, env), eval_expr(expr.high, env)
+        hit = lo <= v <= hi
+        return (not hit) if expr.negated else hit
+    if isinstance(expr, ast.IsNull):
+        v = eval_expr(expr.operand, env)
+        isnull = v is None or v == []
+        return (not isnull) if expr.negated else isnull
+    if isinstance(expr, ast.Like):
+        v = eval_expr(expr.operand, env)
+        if v is None:
+            return None
+        hit = bool(_like_to_regex(expr.pattern).match(str(v)))
+        return (not hit) if expr.negated else hit
+    if isinstance(expr, ast.FuncCall):
+        return _eval_func(expr, env)
+    raise SQLError(f"cannot evaluate {type(expr).__name__} on the host")
+
+
+def _truthy(v) -> bool:
+    return bool(v)
+
+
+def _eval_func(f: ast.FuncCall, env: Dict[str, Any]) -> Any:
+    name = f.name
+    if name in ("SETCONTAINS", "SETCONTAINSANY", "SETCONTAINSALL"):
+        target = eval_expr(f.args[0], env)
+        if target is None:
+            return False
+        target = set(target if isinstance(target, list) else [target])
+        probe = eval_expr(f.args[1], env)
+        probe = set(probe if isinstance(probe, list) else [probe])
+        if name == "SETCONTAINSALL":
+            return probe <= target
+        return bool(probe & target)  # CONTAINS(single) == ANY(singleton)
+    args = [eval_expr(a, env) for a in f.args]
+    if name == "UPPER":
+        return None if args[0] is None else str(args[0]).upper()
+    if name == "LOWER":
+        return None if args[0] is None else str(args[0]).lower()
+    if name == "LEN":
+        return None if args[0] is None else len(args[0])
+    if name == "ABS":
+        return None if args[0] is None else abs(args[0])
+    raise SQLError(f"unknown function {name}")
+
+
+# -- host operators ----------------------------------------------------------
+
+class FilterOp(PlanOp):
+    def __init__(self, child: PlanOp, predicate: ast.Expr):
+        self.child, self.predicate = child, predicate
+        self.schema = child.schema
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        names = [n for n, _ in self.child.schema]
+        for row in self.child.rows():
+            env = dict(zip(names, row))
+            if _truthy(eval_expr(self.predicate, env) or False):
+                yield row
+
+
+class ProjectOp(PlanOp):
+    def __init__(self, child: PlanOp, items: List[Tuple[str, str, ast.Expr]]):
+        """items: (output name, output sql type, expr over child columns)."""
+        self.child = child
+        self._items = items
+        self.schema = [(n, t) for n, t, _ in items]
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        names = [n for n, _ in self.child.schema]
+        for row in self.child.rows():
+            env = dict(zip(names, row))
+            yield [eval_expr(e, env) for _, _, e in self._items]
+
+
+class OrderByOp(PlanOp):
+    def __init__(self, child: PlanOp, terms: List[Tuple[ast.Expr, bool]]):
+        self.child, self._terms = child, terms
+        self.schema = child.schema
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        names = [n for n, _ in self.child.schema]
+        data = list(self.child.rows())
+        # stable multi-key sort: apply terms right-to-left
+        for expr, desc in reversed(self._terms):
+            def key(row, expr=expr):
+                v = eval_expr(expr, dict(zip(names, row)))
+                if isinstance(v, list):
+                    v = tuple(v)
+                return (v is None, v)  # NULLs last
+            data.sort(key=key, reverse=desc)
+        return iter(data)
+
+
+class LimitOp(PlanOp):
+    def __init__(self, child: PlanOp, limit: Optional[int],
+                 offset: Optional[int] = None):
+        self.child, self._limit, self._offset = child, limit, offset or 0
+        self.schema = child.schema
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        n = 0
+        skipped = 0
+        for row in self.child.rows():
+            if skipped < self._offset:
+                skipped += 1
+                continue
+            if self._limit is not None and n >= self._limit:
+                return
+            n += 1
+            yield row
+
+
+class DistinctOp(PlanOp):
+    """Host dedupe (reference: sql3/planner/opdistinct.go, which uses an
+    extendible hash table; result streams here are post-reduction and
+    small, so a set suffices)."""
+
+    def __init__(self, child: PlanOp):
+        self.child = child
+        self.schema = child.schema
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        seen = set()
+        for row in self.child.rows():
+            key = tuple(tuple(v) if isinstance(v, list) else v for v in row)
+            if key not in seen:
+                seen.add(key)
+                yield row
+
+
+class GroupByOp(PlanOp):
+    """Host-side grouping fallback for shapes the PQL GroupBy kernel
+    doesn't cover (grouping by INT columns, MIN/MAX/AVG aggregates).
+    Reference: sql3/planner/opgroupby.go."""
+
+    def __init__(self, child: PlanOp, group_names: List[str],
+                 aggs: List[Tuple[str, str, "AggSpec"]]):
+        self.child = child
+        self._groups = group_names
+        self._aggs = aggs
+        types = dict(child.schema)
+        gschema = [(n, types[n]) for n in group_names]  # GROUP BY order
+        self.schema = gschema + [(n, t) for n, t, _ in aggs]
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        names = [n for n, _ in self.child.schema]
+        groups: Dict[tuple, List[AggState]] = {}
+        order: List[tuple] = []
+        for row in self.child.rows():
+            env = dict(zip(names, row))
+            key = tuple(_hashable(env[g]) for g in self._groups)
+            if key not in groups:
+                groups[key] = [spec.new_state() for _, _, spec in self._aggs]
+                order.append(key)
+            for st, (_, _, spec) in zip(groups[key], self._aggs):
+                st.add(env)
+        for key in order:
+            yield list(key) + [st.result() for st in groups[key]]
+
+
+def _hashable(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+class AggState:
+    def __init__(self, spec: "AggSpec"):
+        self.spec = spec
+        self.count = 0
+        self.total = 0
+        self.mn = None
+        self.mx = None
+        self.distinct = set()
+
+    def add(self, env: Dict[str, Any]):
+        f = self.spec
+        if f.func == "COUNT" and f.expr is None:
+            self.count += 1
+            return
+        v = eval_expr(f.expr, env)
+        if v is None or v == []:
+            return
+        if f.distinct:
+            self.distinct.add(_hashable(v))
+            return
+        self.count += 1
+        if isinstance(v, (int, float)):
+            self.total += v
+            self.mn = v if self.mn is None else min(self.mn, v)
+            self.mx = v if self.mx is None else max(self.mx, v)
+
+    def result(self):
+        f = self.spec
+        if f.func == "COUNT":
+            return len(self.distinct) if f.distinct else self.count
+        if f.func == "SUM":
+            return self.total if self.count else None
+        if f.func == "AVG":
+            return (self.total / self.count) if self.count else None
+        if f.func == "MIN":
+            return self.mn
+        if f.func == "MAX":
+            return self.mx
+        raise SQLError(f"aggregate {f.func} not supported in host group-by")
+
+
+class AggSpec:
+    def __init__(self, func: str, expr: Optional[ast.Expr], distinct=False):
+        self.func, self.expr, self.distinct = func, expr, distinct
+
+    def new_state(self) -> AggState:
+        return AggState(self)

@@ -1,0 +1,739 @@
+"""SQL planner: analyze + compile statements to plan-operator trees.
+
+Reference: sql3/planner/executionplanner.go:32 (CompilePlan: analyze ->
+compile -> optimize). The central optimization here is the same one the
+reference's planoptimizer.go performs — push WHERE trees down into the
+bitmap engine (filter pushdown into PQL table scans, aggregate fusion
+into PQL aggregate/groupby calls) — so the heavy work runs as TPU kernels
+and the host only sees reduced streams. Expressions with no bitmap form
+fall back to a host filter over the scan.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Any, Dict, List, Optional, Tuple
+
+from pilosa_tpu.core.field import Field
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.schema import FieldType
+from pilosa_tpu.pql.ast import Call, Condition, Query
+from pilosa_tpu.sql import ast, plan
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.plan import AggSpec, CallbackOp, PlanOp, Schema, StaticOp
+from pilosa_tpu.sql.types import field_to_sql_type, id_sql_type
+
+AGGS = {"COUNT", "SUM", "AVG", "MIN", "MAX", "PERCENTILE"}
+
+_TIME_UNITS_PER_S = {"s": 1, "ms": 1000, "us": 10**6, "ns": 10**9}
+
+
+class CannotLower(Exception):
+    """Raised when a WHERE expression has no PQL/bitmap form."""
+
+
+class Planner:
+    def __init__(self, api):
+        self.api = api
+
+    # -- entry ---------------------------------------------------------------
+
+    def plan_select(self, s: ast.SelectStatement) -> PlanOp:
+        if s.table is None:
+            return self._select_no_table(s)
+        self._hidden = []
+        self._agg_names: Dict[str, str] = {}
+        idx = self.api.holder.index(s.table)
+        items = self._expand_star(idx, s.items)
+        if s.group_by or any(_contains_agg(it.expr) for it in items):
+            op = self._plan_aggregate(idx, s, items)
+        else:
+            op = self._plan_scan_select(idx, s, items)
+        if s.order_by:
+            op = self._apply_order(op, s, items)
+        if s.distinct:
+            op = plan.DistinctOp(op)
+        limit = s.limit if s.limit is not None else s.top
+        if limit is not None or s.offset:
+            op = plan.LimitOp(op, limit, s.offset)
+        return op
+
+    def _select_no_table(self, s: ast.SelectStatement) -> PlanOp:
+        row = [plan.eval_expr(it.expr, {}) for it in s.items]
+        schema = [(it.alias or f"col_{i}", _literal_type(v))
+                  for i, (it, v) in enumerate(zip(s.items, row))]
+        return StaticOp(schema, [row])
+
+    # -- star expansion & naming ---------------------------------------------
+
+    def _expand_star(self, idx: Index, items: List[ast.SelectItem]
+                     ) -> List[ast.SelectItem]:
+        out: List[ast.SelectItem] = []
+        for it in items:
+            if isinstance(it.expr, ast.Star):
+                out.append(ast.SelectItem(ast.ColumnRef("_id")))
+                for f in idx.public_fields():
+                    out.append(ast.SelectItem(ast.ColumnRef(f.name)))
+            else:
+                out.append(it)
+        return out
+
+    def _item_name(self, it: ast.SelectItem, i: int) -> str:
+        if it.alias:
+            return it.alias
+        if isinstance(it.expr, ast.ColumnRef):
+            return it.expr.name
+        if isinstance(it.expr, ast.FuncCall):
+            return it.expr.name.lower()
+        return f"col_{i}"
+
+    def _item_type(self, idx: Index, expr: ast.Expr) -> str:
+        if isinstance(expr, ast.ColumnRef):
+            if expr.name == "_id":
+                return id_sql_type(idx.options.keys)
+            return field_to_sql_type(idx.field(expr.name).options)
+        if isinstance(expr, ast.FuncCall):
+            if expr.name == "COUNT":
+                return "INT"
+            if expr.name in ("SUM", "MIN", "MAX", "PERCENTILE"):
+                if expr.args and isinstance(expr.args[0], ast.ColumnRef):
+                    return self._item_type(idx, expr.args[0])
+                return "INT"
+            if expr.name == "AVG":
+                return "DECIMAL(4)"
+            if expr.name in ("SETCONTAINS", "SETCONTAINSANY", "SETCONTAINSALL"):
+                return "BOOL"
+            return "INT"
+        if isinstance(expr, ast.Literal):
+            return _literal_type(expr.value)
+        if isinstance(expr, (ast.Binary,)) and expr.op in (
+                "=", "!=", "<", "<=", ">", ">=", "AND", "OR"):
+            return "BOOL"
+        return "INT"
+
+    # -- plain scan select ----------------------------------------------------
+
+    def _plan_scan_select(self, idx: Index, s: ast.SelectStatement,
+                          items: List[ast.SelectItem]) -> PlanOp:
+        needed = set()
+        for it in items:
+            needed |= _columns_of(it.expr)
+        out_names = {self._item_name(it, i) for i, it in enumerate(items)}
+        for t in s.order_by:
+            # alias refs resolve against projected output, not the table
+            needed |= _columns_of(t.expr) - out_names
+        filter_call, host_pred = self._split_filter(idx, s.where)
+        if host_pred is not None:
+            needed |= _columns_of(host_pred)
+        scan = self._scan_op(idx, sorted(needed - {"_id"}), filter_call)
+        op: PlanOp = scan
+        if host_pred is not None:
+            op = plan.FilterOp(op, host_pred)
+        proj = [(self._item_name(it, i), self._item_type(idx, it.expr), it.expr)
+                for i, it in enumerate(items)]
+        # hidden order-by columns ride along; trimmed after the sort
+        self._hidden = []
+        names = {p[0] for p in proj}
+        for t in s.order_by:
+            for c in _columns_of(t.expr):
+                if c not in names:
+                    self._hidden.append((c, self._item_type(idx, ast.ColumnRef(c)),
+                                         ast.ColumnRef(c)))
+                    names.add(c)
+        return plan.ProjectOp(op, proj + self._hidden)
+
+    def _apply_order(self, op: PlanOp, s: ast.SelectStatement,
+                     items: List[ast.SelectItem]) -> PlanOp:
+        # aggregate terms (ORDER BY COUNT(*)) resolve to their computed
+        # columns via the same structural rewrite as projections
+        terms = [(_rewrite_aggs(t.expr, self._agg_names), t.desc)
+                 for t in s.order_by]
+        op = plan.OrderByOp(op, terms)
+        hidden = getattr(self, "_hidden", [])
+        if hidden:
+            keep = len(op.schema) - len(hidden)
+            op = _TrimOp(op, keep)
+            self._hidden = []
+        return op
+
+    # -- scan (PQL Extract bridge) --------------------------------------------
+
+    def _scan_op(self, idx: Index, field_names: List[str],
+                 filter_call: Optional[Call]) -> CallbackOp:
+        """Table scan: Extract(filter, Rows(f)...) on the kernel engine
+        (reference: sql3/planner/oppqltablescan.go)."""
+        fields = [idx.field(f) for f in field_names]
+        schema: Schema = [("_id", id_sql_type(idx.options.keys))]
+        schema += [(f.name, field_to_sql_type(f.options)) for f in fields]
+        executor = self.api.executor
+
+        def thunk():
+            call = Call("Extract",
+                        children=[filter_call or Call("All")] +
+                                 [Call("Rows", {"_field": f}) for f in field_names])
+            table = executor.execute(idx.name, Query([call]))[0]
+            for col in table.columns:
+                row: List[Any] = [col.key if idx.options.keys else col.column]
+                for f, v in zip(fields, col.rows):
+                    row.append(_convert_scan_value(f, v))
+                yield row
+
+        return CallbackOp(schema, thunk, name="PQLTableScan")
+
+    # -- WHERE lowering --------------------------------------------------------
+
+    def _split_filter(self, idx: Index, where: Optional[ast.Expr]
+                      ) -> Tuple[Optional[Call], Optional[ast.Expr]]:
+        """Lower as much of WHERE as possible to a PQL call. Top-level AND
+        conjuncts are lowered independently (reference:
+        planoptimizer.go filter pushdown); whatever can't be lowered is
+        returned as a host predicate."""
+        if where is None:
+            return None, None
+        conjuncts = _flatten_and(where)
+        lowered: List[Call] = []
+        host: List[ast.Expr] = []
+        for c in conjuncts:
+            try:
+                lowered.append(self.lower_filter(idx, c))
+            except CannotLower:
+                host.append(c)
+        fc = None
+        if len(lowered) == 1:
+            fc = lowered[0]
+        elif lowered:
+            fc = Call("Intersect", children=lowered)
+        hp = None
+        for h in host:
+            hp = h if hp is None else ast.Binary("AND", hp, h)
+        return fc, hp
+
+    def lower_filter(self, idx: Index, e: ast.Expr) -> Call:
+        if isinstance(e, ast.Binary):
+            if e.op == "AND":
+                return Call("Intersect", children=[
+                    self.lower_filter(idx, e.left),
+                    self.lower_filter(idx, e.right)])
+            if e.op == "OR":
+                return Call("Union", children=[
+                    self.lower_filter(idx, e.left),
+                    self.lower_filter(idx, e.right)])
+            if e.op in ("=", "!=", "<", "<=", ">", ">="):
+                return self._lower_cmp(idx, e)
+            raise CannotLower(e.op)
+        if isinstance(e, ast.Unary) and e.op == "NOT":
+            return Call("Not", children=[self.lower_filter(idx, e.operand)])
+        if isinstance(e, ast.InList):
+            col, vals = _col_and_literals(e.operand, e.items)
+            if col is None:
+                raise CannotLower("IN")
+            inner = self._lower_in(idx, col, vals)
+            if not e.negated:
+                return inner
+            if col == "_id":
+                return Call("Not", children=[inner])
+            # NOT IN excludes NULL rows (three-valued logic, as above)
+            return Call("Difference",
+                        children=[self._notnull_call(idx, col), inner])
+        if isinstance(e, ast.Between):
+            if not isinstance(e.operand, ast.ColumnRef):
+                raise CannotLower("BETWEEN")
+            lo, hi = _literal(e.low), _literal(e.high)
+            f = self._bsi_field(idx, e.operand.name)
+            c = Call("Row", {f.name: Condition("between", [lo, hi])})
+            return Call("Not", children=[c]) if e.negated else c
+        if isinstance(e, ast.IsNull):
+            if not isinstance(e.operand, ast.ColumnRef):
+                raise CannotLower("IS NULL")
+            name = e.operand.name
+            field = idx.field(name)
+            if field.options.type.is_bsi:
+                notnull = Call("Row", {name: Condition("!=", None)})
+            else:
+                notnull = Call("UnionRows",
+                               children=[Call("Rows", {"_field": name})])
+            return notnull if e.negated else Call("Not", children=[notnull])
+        if isinstance(e, ast.FuncCall):
+            return self._lower_func(idx, e)
+        if isinstance(e, ast.Literal):
+            if e.value is True:
+                return Call("All")
+            raise CannotLower("literal")
+        if isinstance(e, ast.ColumnRef):
+            field = idx.field(e.name)
+            if field.options.type == FieldType.BOOL:
+                return Call("Row", {e.name: True})
+            raise CannotLower("bare column")
+        raise CannotLower(type(e).__name__)
+
+    def _lower_cmp(self, idx: Index, e: ast.Binary) -> Call:
+        col, lit, op = None, None, e.op
+        if isinstance(e.left, ast.ColumnRef) and isinstance(e.right, ast.Literal):
+            col, lit = e.left.name, e.right.value
+        elif isinstance(e.right, ast.ColumnRef) and isinstance(e.left, ast.Literal):
+            col, lit = e.right.name, e.left.value
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if col is None:
+            raise CannotLower("cmp")
+        if col == "_id":
+            if op == "=":
+                return Call("ConstRow", {"columns": [lit]})
+            if op == "!=":
+                return Call("Not",
+                            children=[Call("ConstRow", {"columns": [lit]})])
+            raise CannotLower("_id range")
+        field = idx.field(col)
+        t = field.options.type
+        if t.is_bsi:
+            if lit is None:
+                c = Call("Row", {col: Condition("!=", None)})
+                return c if op == "!=" else Call("Not", children=[c])
+            pql_op = {"=": "==", "!=": "!=", "<": "<", "<=": "<=",
+                      ">": ">", ">=": ">="}[op]
+            return Call("Row", {col: Condition(pql_op, lit)})
+        # set/mutex/bool/time equality
+        if op == "=":
+            return Call("Row", {col: lit})
+        if op == "!=":
+            # SQL three-valued logic: NULL != lit is unknown, so complement
+            # within the not-null set, not within all records
+            return Call("Difference",
+                        children=[self._notnull_call(idx, col),
+                                  Call("Row", {col: lit})])
+        raise CannotLower(f"{t.value} {op}")
+
+    def _notnull_call(self, idx: Index, col: str) -> Call:
+        field = idx.field(col)
+        if field.options.type.is_bsi:
+            return Call("Row", {col: Condition("!=", None)})
+        return Call("UnionRows", children=[Call("Rows", {"_field": col})])
+
+    def _lower_in(self, idx: Index, col: str, vals: List[Any]) -> Call:
+        if col == "_id":
+            return Call("ConstRow", {"columns": list(vals)})
+        rows = [Call("Row", {col: v}) for v in vals]
+        if len(rows) == 1:
+            return rows[0]
+        return Call("Union", children=rows)
+
+    def _lower_func(self, idx: Index, e: ast.FuncCall) -> Call:
+        if e.name in ("SETCONTAINS", "SETCONTAINSANY", "SETCONTAINSALL"):
+            if not isinstance(e.args[0], ast.ColumnRef):
+                raise CannotLower(e.name)
+            col = e.args[0].name
+            probe = _literal(e.args[1])
+            vals = probe if isinstance(probe, list) else [probe]
+            rows = [Call("Row", {col: v}) for v in vals]
+            if len(rows) == 1:
+                return rows[0]
+            comb = "Intersect" if e.name == "SETCONTAINSALL" else "Union"
+            return Call(comb, children=rows)
+        raise CannotLower(e.name)
+
+    def _bsi_field(self, idx: Index, name: str) -> Field:
+        f = idx.field(name)
+        if not f.options.type.is_bsi:
+            raise CannotLower(f"{name} is not int-like")
+        return f
+
+    # -- aggregate queries -----------------------------------------------------
+
+    def _plan_aggregate(self, idx: Index, s: ast.SelectStatement,
+                        items: List[ast.SelectItem]) -> PlanOp:
+        aggs = _collect_aggs(items, s.having, s.order_by)
+        if s.group_by:
+            return self._plan_groupby(idx, s, items, aggs)
+        # no GROUP BY: single output row, each aggregate is one kernel query
+        filter_call, host_pred = self._split_filter(idx, s.where)
+        if host_pred is not None:
+            return self._plan_host_aggregate(idx, s, items, aggs)
+        executor = self.api.executor
+        agg_names = self._name_aggs(aggs)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        schema = [(self._item_name(it, i), self._item_type(idx, it.expr))
+                  for i, it in enumerate(items)]
+        schema += [(n, t) for n, t, _ in hidden]
+
+        def thunk():
+            env: Dict[str, Any] = {}
+            for a in aggs:
+                env[agg_names[_agg_key(a)]] = self._run_agg(idx, a, filter_call)
+            row = [plan.eval_expr(_rewrite_aggs(it.expr, agg_names), env)
+                   for it in items]
+            row += [plan.eval_expr(e, env) for _, _, e in hidden]
+            rows = [row]
+            if s.having is not None:
+                hv = _rewrite_aggs(s.having, agg_names)
+                rows = [r for r in rows if plan.eval_expr(hv, env)]
+            return iter(rows)
+
+        return CallbackOp(schema, thunk, name="PQLAggregate")
+
+    def _name_aggs(self, aggs: List[ast.FuncCall]) -> Dict[str, str]:
+        names = {_agg_key(a): f"__agg{i}" for i, a in enumerate(aggs)}
+        self._agg_names = names
+        return names
+
+    def _hidden_agg_items(self, idx: Index, items: List[ast.SelectItem],
+                          aggs: List[ast.FuncCall],
+                          order_by: List[ast.OrderTerm]):
+        """Aggregates referenced only by ORDER BY ride along as hidden
+        output columns and are trimmed after the sort."""
+        if not order_by:
+            self._hidden = []
+            return []
+        # every aggregate rides along under its __aggN name so rewritten
+        # ORDER BY terms always resolve (projected copies may be aliased)
+        hidden = []
+        for a in aggs:
+            name = self._agg_names[_agg_key(a)]
+            hidden.append((name, self._item_type(idx, a),
+                           ast.ColumnRef(name)))
+        self._hidden = hidden
+        return hidden
+
+    def _run_agg(self, idx: Index, a: ast.FuncCall,
+                 filter_call: Optional[Call]) -> Any:
+        """One aggregate -> one PQL call (reference:
+        sql3/planner/oppqlaggregate.go + planoptimizer aggregate fusion)."""
+        executor = self.api.executor
+
+        def run(call: Call):
+            return executor.execute(idx.name, Query([call]))[0]
+
+        if a.name == "COUNT":
+            if a.distinct:
+                col = _agg_col(a)
+                dcall = Call("Distinct", {"_field": col},
+                             children=[filter_call] if filter_call else [])
+                res = run(dcall)
+                if isinstance(res, list):
+                    return len(res)
+                return len(res.keys if res.keys is not None else res.columns)
+            if isinstance(a.args[0], ast.Star):
+                return run(Call("Count",
+                                children=[filter_call or Call("All")]))
+            col = _agg_col(a)
+            field = idx.field(col)
+            if field.options.type.is_bsi:
+                vc = run(Call("Sum", {"field": col},
+                              children=[filter_call] if filter_call else []))
+                return vc.count
+            exists = Call("UnionRows", children=[Call("Rows", {"_field": col})])
+            target = Call("Intersect", children=[filter_call, exists]) \
+                if filter_call else exists
+            return run(Call("Count", children=[target]))
+        col = _agg_col(a)
+        if a.name == "PERCENTILE":
+            nth = _literal(a.args[1]) if len(a.args) > 1 else 50
+            vc = run(Call("Percentile",
+                          {"field": col, "nth": nth},
+                          children=[filter_call] if filter_call else []))
+            return vc.val
+        field = idx.field(col)
+        if not field.options.type.is_bsi:
+            raise SQLError(f"{a.name}() requires an int-like column")
+        if a.name == "AVG":
+            vc = run(Call("Sum", {"field": col},
+                          children=[filter_call] if filter_call else []))
+            return (vc.val / vc.count) if vc.count else None
+        call_name = {"SUM": "Sum", "MIN": "Min", "MAX": "Max"}[a.name]
+        vc = run(Call(call_name, {"field": col},
+                      children=[filter_call] if filter_call else []))
+        return vc.val if vc.count else None
+
+    # -- GROUP BY --------------------------------------------------------------
+
+    def _plan_groupby(self, idx: Index, s: ast.SelectStatement,
+                      items: List[ast.SelectItem],
+                      aggs: List[ast.FuncCall]) -> PlanOp:
+        group_cols: List[str] = []
+        for g in s.group_by:
+            if not isinstance(g, ast.ColumnRef):
+                return self._plan_host_aggregate(idx, s, items, aggs)
+            group_cols.append(g.name)
+        filter_call, host_pred = self._split_filter(idx, s.where)
+        fast = host_pred is None and self._groupby_fast_ok(idx, group_cols, aggs)
+        if not fast:
+            return self._plan_host_aggregate(idx, s, items, aggs)
+        return self._plan_pql_groupby(idx, s, items, aggs, group_cols,
+                                      filter_call)
+
+    def _groupby_fast_ok(self, idx: Index, group_cols: List[str],
+                         aggs: List[ast.FuncCall]) -> bool:
+        for c in group_cols:
+            if c == "_id":
+                return False
+            t = idx.field(c).options.type
+            if t.is_bsi:
+                return False
+        sum_cols = set()
+        for a in aggs:
+            if a.name == "COUNT" and not a.distinct and a.args and \
+                    isinstance(a.args[0], ast.Star):
+                continue
+            if a.name == "SUM" and isinstance(a.args[0], ast.ColumnRef):
+                sum_cols.add(a.args[0].name)
+                continue
+            return False
+        return len(sum_cols) <= 1
+
+    def _plan_pql_groupby(self, idx: Index, s: ast.SelectStatement,
+                          items: List[ast.SelectItem],
+                          aggs: List[ast.FuncCall], group_cols: List[str],
+                          filter_call: Optional[Call]) -> PlanOp:
+        """GroupBy on the kernel engine (reference:
+        sql3/planner/oppqlgroupby.go + oppqlmultigroupby fusion)."""
+        executor = self.api.executor
+        agg_names = self._name_aggs(aggs)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        sum_col = next((a.args[0].name for a in aggs if a.name == "SUM"), None)
+        gfields = [idx.field(c) for c in group_cols]
+        schema = [(self._item_name(it, i), self._item_type(idx, it.expr))
+                  for i, it in enumerate(items)]
+        schema += [(n, t) for n, t, _ in hidden]
+
+        def thunk():
+            args: Dict[str, Any] = {}
+            if filter_call is not None:
+                args["filter"] = filter_call
+            if sum_col is not None:
+                args["aggregate"] = Call("Sum", {"field": sum_col})
+            call = Call("GroupBy", args,
+                        children=[Call("Rows", {"_field": c})
+                                  for c in group_cols])
+            groups = executor.execute(idx.name, Query([call]))[0]
+            for gc in groups:
+                env: Dict[str, Any] = {}
+                for f, fr in zip(gfields, gc.group):
+                    v = fr.row_key if fr.row_key is not None else fr.row_id
+                    if f.options.type == FieldType.BOOL:
+                        v = bool(v)
+                    env[f.name] = v
+                for a in aggs:
+                    if a.name == "COUNT":
+                        env[agg_names[_agg_key(a)]] = gc.count
+                    else:
+                        sv = gc.agg
+                        if sv is not None:
+                            sv = idx.field(sum_col).from_stored(sv) \
+                                if idx.field(sum_col).options.type == \
+                                FieldType.DECIMAL else sv
+                        env[agg_names[_agg_key(a)]] = sv
+                if s.having is not None:
+                    hv = _rewrite_aggs(s.having, agg_names)
+                    if not plan.eval_expr(hv, env):
+                        continue
+                yield [plan.eval_expr(_rewrite_aggs(it.expr, agg_names), env)
+                       for it in items] + \
+                    [plan.eval_expr(e, env) for _, _, e in hidden]
+
+        return CallbackOp(schema, thunk, name="PQLGroupBy")
+
+    def _plan_host_aggregate(self, idx: Index, s: ast.SelectStatement,
+                             items: List[ast.SelectItem],
+                             aggs: List[ast.FuncCall]) -> PlanOp:
+        """Fallback: scan + host grouping (reference: opgroupby.go when
+        PQL fusion doesn't apply)."""
+        needed = set()
+        for it in items:
+            needed |= _columns_of(it.expr)
+        for g in s.group_by:
+            needed |= _columns_of(g)
+        if s.having is not None:
+            needed |= _columns_of(s.having)
+        filter_call, host_pred = self._split_filter(idx, s.where)
+        if host_pred is not None:
+            needed |= _columns_of(host_pred)
+        scan: PlanOp = self._scan_op(idx, sorted(needed - {"_id"}), filter_call)
+        if host_pred is not None:
+            scan = plan.FilterOp(scan, host_pred)
+        group_names = []
+        for g in s.group_by:
+            if not isinstance(g, ast.ColumnRef):
+                raise SQLError("GROUP BY supports plain columns")
+            group_names.append(g.name)
+        agg_names = self._name_aggs(aggs)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        specs = []
+        for a in aggs:
+            expr = None if (a.args and isinstance(a.args[0], ast.Star)) \
+                else (a.args[0] if a.args else None)
+            specs.append((agg_names[_agg_key(a)], "INT",
+                          AggSpec(a.name, expr, distinct=a.distinct)))
+        op: PlanOp = plan.GroupByOp(scan, group_names, specs)
+        if s.having is not None:
+            op = plan.FilterOp(op, _rewrite_aggs(s.having, agg_names))
+        proj = [(self._item_name(it, i), self._item_type(idx, it.expr),
+                 _rewrite_aggs(it.expr, agg_names))
+                for i, it in enumerate(items)] + hidden
+        return plan.ProjectOp(op, proj)
+
+
+class _TrimOp(PlanOp):
+    """Drop hidden trailing columns added for ORDER BY."""
+
+    def __init__(self, child: PlanOp, keep: int):
+        self.child, self._keep = child, keep
+        self.schema = child.schema[:keep]
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self):
+        for row in self.child.rows():
+            yield row[: self._keep]
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _flatten_and(e: ast.Expr) -> List[ast.Expr]:
+    if isinstance(e, ast.Binary) and e.op == "AND":
+        return _flatten_and(e.left) + _flatten_and(e.right)
+    return [e]
+
+
+def _columns_of(e: ast.Expr) -> set:
+    out: set = set()
+    if isinstance(e, ast.ColumnRef):
+        out.add(e.name)
+    elif isinstance(e, ast.Binary):
+        out |= _columns_of(e.left) | _columns_of(e.right)
+    elif isinstance(e, ast.Unary):
+        out |= _columns_of(e.operand)
+    elif isinstance(e, ast.InList):
+        out |= _columns_of(e.operand)
+        for it in e.items:
+            out |= _columns_of(it)
+    elif isinstance(e, ast.Between):
+        out |= _columns_of(e.operand) | _columns_of(e.low) | _columns_of(e.high)
+    elif isinstance(e, (ast.IsNull, ast.Like)):
+        out |= _columns_of(e.operand)
+    elif isinstance(e, ast.FuncCall):
+        for a in e.args:
+            out |= _columns_of(a)
+    return out
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name in AGGS:
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, ast.Binary):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, ast.Unary):
+        return _contains_agg(e.operand)
+    return False
+
+
+def _agg_key(e: ast.FuncCall) -> str:
+    """Structural identity of an aggregate expression (dataclass repr),
+    so COUNT(*) in ORDER BY matches COUNT(*) in the projection."""
+    return repr(e)
+
+
+def _collect_aggs(items: List[ast.SelectItem], having: Optional[ast.Expr],
+                  order_by: List[ast.OrderTerm] = ()) -> List[ast.FuncCall]:
+    out: List[ast.FuncCall] = []
+    seen: set = set()
+
+    def walk(e: ast.Expr):
+        if isinstance(e, ast.FuncCall) and e.name in AGGS:
+            k = _agg_key(e)
+            if k not in seen:
+                seen.add(k)
+                out.append(e)
+            return
+        if isinstance(e, ast.Binary):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, ast.Unary):
+            walk(e.operand)
+        elif isinstance(e, ast.FuncCall):
+            for a in e.args:
+                walk(a)
+
+    for it in items:
+        walk(it.expr)
+    if having is not None:
+        walk(having)
+    for t in order_by:
+        walk(t.expr)
+    return out
+
+
+def _rewrite_aggs(e: ast.Expr, names: Dict[str, str]) -> ast.Expr:
+    """Replace aggregate FuncCall nodes with refs to their computed
+    columns (matched structurally via _agg_key)."""
+    if isinstance(e, ast.FuncCall) and e.name in AGGS and \
+            _agg_key(e) in names:
+        return ast.ColumnRef(names[_agg_key(e)])
+    if isinstance(e, ast.Binary):
+        return ast.Binary(e.op, _rewrite_aggs(e.left, names),
+                          _rewrite_aggs(e.right, names))
+    if isinstance(e, ast.Unary):
+        return ast.Unary(e.op, _rewrite_aggs(e.operand, names))
+    return e
+
+
+def _agg_col(a: ast.FuncCall) -> str:
+    if not a.args or not isinstance(a.args[0], ast.ColumnRef):
+        raise SQLError(f"{a.name}() requires a column argument")
+    return a.args[0].name
+
+
+def _col_and_literals(operand: ast.Expr, items: List[ast.Expr]):
+    if not isinstance(operand, ast.ColumnRef):
+        return None, None
+    vals = []
+    for it in items:
+        if not isinstance(it, ast.Literal):
+            return None, None
+        vals.append(it.value)
+    return operand.name, vals
+
+
+def _literal(e: ast.Expr):
+    if isinstance(e, ast.Literal):
+        return e.value
+    if isinstance(e, ast.Unary) and e.op == "-" and \
+            isinstance(e.operand, ast.Literal):
+        return -e.operand.value
+    raise CannotLower("non-literal")
+
+
+def _literal_type(v) -> str:
+    if isinstance(v, bool):
+        return "BOOL"
+    if isinstance(v, int):
+        return "INT"
+    if isinstance(v, float):
+        return "DECIMAL(4)"
+    if isinstance(v, str):
+        return "STRING"
+    return "STRING"
+
+
+def _convert_scan_value(f: Field, v):
+    """ExtractedColumn value -> SQL value (reference: sql3 type coercion
+    from PQL extract results, oppqltablescan.go row materialization)."""
+    t = f.options.type
+    if t.is_bsi:
+        if v is None:
+            return None
+        if t == FieldType.TIMESTAMP:
+            units = _TIME_UNITS_PER_S[f.options.time_unit]
+            ts = dt.datetime.fromtimestamp(v / units, tz=dt.timezone.utc)
+            return ts.isoformat().replace("+00:00", "Z")
+        return v
+    if t == FieldType.BOOL:
+        return bool(v)
+    if t in (FieldType.MUTEX,):
+        if isinstance(v, list):
+            return v[0] if v else None
+        return v
+    # set-like
+    if isinstance(v, list):
+        return v if v else None
+    return v

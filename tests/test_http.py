@@ -189,3 +189,25 @@ def test_errors(server):
         req(base, "POST", "/index/i/query", b"\xff\xfe not json",
             ctype="application/json")
     assert e.value.code in (400, 500)
+
+
+def test_sql_endpoint(server):
+    base = server
+
+    def sql(q):
+        return req(base, "POST", "/sql", body=q.encode(), ctype="text/plain")
+
+    code, out = sql("CREATE TABLE metros (_id ID, name STRING, pop INT)")
+    assert code == 200, out
+    code, out = sql("INSERT INTO metros (_id, name, pop) VALUES "
+                    "(1, 'nyc', 8000000), (2, 'sf', 800000)")
+    assert code == 200 and out["rows-affected"] == 2
+    code, out = sql("SELECT _id, name, pop FROM metros WHERE pop > 1000000")
+    assert code == 200
+    assert out["data"] == [[1, "nyc", 8000000]]
+    assert [f["name"] for f in out["schema"]["fields"]] == ["_id", "name", "pop"]
+    try:
+        code, _ = sql("SELEC nonsense")
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
