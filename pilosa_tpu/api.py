@@ -25,6 +25,7 @@ class API:
     def __init__(self, path: Optional[str] = None):
         self.holder = Holder(path)
         self.executor = Executor(self.holder)
+        self._sql_engine = None
         if path:
             load_holder_data(self.holder)
 
@@ -76,10 +77,13 @@ class API:
     def sql(self, query: str):
         """Execute a SQL statement (reference: server/sql.go:17 execSQL).
         Returns a pilosa_tpu.sql.SQLResult."""
-        if not hasattr(self, "_sql_engine"):
+        eng = self._sql_engine
+        if eng is None:
+            # import deferred to keep API usable without the sql package;
+            # benign if two threads race (same-state engines)
             from pilosa_tpu.sql import SQLEngine
-            self._sql_engine = SQLEngine(self)
-        return self._sql_engine.query(query)
+            eng = self._sql_engine = SQLEngine(self)
+        return eng.query(query)
 
     def query_json(self, index: str, pql: str) -> dict:
         results = [result_to_json(r) for r in self.query(index, pql)]

@@ -1,0 +1,14 @@
+"""Ingest kit: batch importer, record sources, ingester driver, auto-ID.
+
+Reference: batch/ (client-side columnar batcher, batch/batch.go:99),
+idk/ (ingester framework: Source iface idk/interfaces.go, Main driver
+idk/ingest.go:59), idalloc.go (crash-safe ID reservation).
+"""
+
+from pilosa_tpu.ingest.batch import Batch
+from pilosa_tpu.ingest.idalloc import IDAllocator
+from pilosa_tpu.ingest.source import CSVSource, ListSource, Record, Source
+from pilosa_tpu.ingest.ingest import Ingester
+
+__all__ = ["Batch", "IDAllocator", "CSVSource", "ListSource", "Record",
+           "Source", "Ingester"]

@@ -1,0 +1,96 @@
+"""Kafka record source (gated).
+
+Reference: idk/kafka/ — a cgo confluent-kafka consumer feeding the idk
+Main loop with Avro/JSON decoding. The client library is an *external
+dependency* in the reference too (SURVEY.md header note); this build
+gates on an importable kafka client rather than bundling one. The JSON
+message decoding and Source surface match the reference's
+``idk/kafka_static`` JSON mode.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from pilosa_tpu.core.schema import FieldOptions
+from pilosa_tpu.ingest.source import Record, Source, _parse_header
+
+
+def _kafka_client():
+    try:
+        import confluent_kafka  # type: ignore
+        return confluent_kafka
+    except ImportError:
+        try:
+            import kafka  # type: ignore  # kafka-python
+            return kafka
+        except ImportError:
+            raise ImportError(
+                "no kafka client installed (confluent_kafka or kafka-python "
+                "required); the KafkaSource is gated like the reference's "
+                "external librdkafka dependency")
+
+
+class KafkaSource(Source):
+    """Consume JSON records from Kafka topics.
+
+    ``fields`` uses the same ``name__TYPE`` annotations as the CSV header
+    (source.py) to type the schema; message values are JSON objects keyed
+    by bare field name.
+    """
+
+    def __init__(self, bootstrap: str, topics: List[str], group: str,
+                 fields: List[str], id_field: Optional[str] = "id",
+                 max_messages: Optional[int] = None, client=None):
+        self._client = client or _kafka_client()
+        self._bootstrap = bootstrap
+        self._topics = topics
+        self._group = group
+        self._schema = _parse_header(fields)
+        self._id = id_field
+        self._max = max_messages
+
+    def schema(self) -> List[Tuple[str, FieldOptions]]:
+        return [(n, o) for n, o in self._schema if n != self._id]
+
+    def id_column(self) -> Optional[str]:
+        return self._id
+
+    def records(self):
+        consumer = self._make_consumer()
+        names = {n for n, _ in self._schema}
+        seen = 0
+        for msg in self._poll(consumer):
+            rec = {k: v for k, v in json.loads(msg).items() if k in names
+                   or k == self._id}
+            yield rec
+            seen += 1
+            if self._max is not None and seen >= self._max:
+                break
+
+    # thin shims so tests can inject a fake client
+    def _make_consumer(self):
+        c = self._client
+        if hasattr(c, "Consumer"):  # confluent-kafka
+            consumer = c.Consumer({"bootstrap.servers": self._bootstrap,
+                                   "group.id": self._group,
+                                   "auto.offset.reset": "earliest"})
+            consumer.subscribe(self._topics)
+            return consumer
+        return c.KafkaConsumer(*self._topics,
+                               bootstrap_servers=self._bootstrap,
+                               group_id=self._group)
+
+    def _poll(self, consumer):
+        if hasattr(consumer, "poll") and not hasattr(consumer, "__iter__"):
+            while True:
+                msg = consumer.poll(timeout=1.0)
+                if msg is None:
+                    return
+                if msg.error():
+                    continue
+                yield msg.value()
+        else:
+            for msg in consumer:
+                yield msg.value

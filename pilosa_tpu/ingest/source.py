@@ -1,0 +1,149 @@
+"""Record sources for the ingester.
+
+Reference: idk/interfaces.go (Source yields Records; fields carry typed
+schema), idk/csv/ (CSV source with header-driven typing). A header cell
+may carry a type suffix like ``age__I`` (int), ``name__S`` (string),
+``tags__SS`` (string set), ``ts__T`` (timestamp), ``ok__B`` (bool),
+``price__F2`` (decimal scale 2) — the analog of idk's header type
+annotations; untyped columns default to string.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import re
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from pilosa_tpu.core.schema import FieldOptions, FieldType
+
+Record = Dict[str, Any]
+
+_TYPE_RE = re.compile(r"^(.*?)__([A-Z]+)(\d*)$")
+
+_SUFFIX_TYPES = {
+    "I": FieldType.INT,
+    "S": FieldType.MUTEX,    # scalar string (keyed mutex)
+    "SS": FieldType.SET,     # string set
+    "IS": FieldType.SET,     # id set (unkeyed)
+    "ID": FieldType.MUTEX,   # scalar id (unkeyed mutex)
+    "B": FieldType.BOOL,
+    "T": FieldType.TIMESTAMP,
+    "F": FieldType.DECIMAL,
+}
+
+
+class Source:
+    """Iterable of Records plus a field schema."""
+
+    def schema(self) -> List[Tuple[str, FieldOptions]]:
+        raise NotImplementedError
+
+    def records(self) -> Iterator[Record]:
+        raise NotImplementedError
+
+    def id_column(self) -> Optional[str]:
+        """Column holding the record id/key, or None for auto-id."""
+        return None
+
+
+class ListSource(Source):
+    """In-memory records with an explicit schema (tests, programmatic)."""
+
+    def __init__(self, schema: List[Tuple[str, FieldOptions]],
+                 records: Iterable[Record], id_col: Optional[str] = "id"):
+        self._schema = list(schema)
+        self._records = list(records)
+        self._id_col = id_col
+
+    def schema(self):
+        return self._schema
+
+    def records(self):
+        return iter(self._records)
+
+    def id_column(self):
+        return self._id_col
+
+
+def _parse_header(cells: List[str]) -> List[Tuple[str, FieldOptions]]:
+    out: List[Tuple[str, FieldOptions]] = []
+    for cell in cells:
+        m = _TYPE_RE.match(cell)
+        if not m:
+            out.append((cell, FieldOptions(type=FieldType.MUTEX, keys=True)))
+            continue
+        name, code, arg = m.groups()
+        t = _SUFFIX_TYPES.get(code)
+        if t is None:
+            raise ValueError(f"unknown type suffix {code!r} in {cell!r}")
+        keys = code in ("S", "SS")
+        opts = FieldOptions(type=t, keys=keys)
+        if t == FieldType.DECIMAL:
+            opts.scale = int(arg or 2)
+        out.append((name, opts))
+    return out
+
+
+def _coerce(raw: str, opts: FieldOptions):
+    if raw == "":
+        return None
+    t = opts.type
+    if t == FieldType.INT:
+        return int(raw)
+    if t == FieldType.DECIMAL:
+        return float(raw)
+    if t == FieldType.BOOL:
+        return raw.strip().lower() in ("1", "true", "t", "yes")
+    if t == FieldType.TIMESTAMP:
+        return raw
+    if t == FieldType.SET:
+        parts = [p for p in raw.split(";") if p]
+        return parts if opts.keys else [int(p) for p in parts]
+    if t == FieldType.MUTEX and not opts.keys:
+        return int(raw)
+    return raw
+
+
+class CSVSource(Source):
+    """CSV with a typed header row (reference: idk/csv/csvsrc.go).
+
+    The id column is the one named ``id`` (auto-detected) or the
+    ``id_col`` argument; when absent, records get auto-ids downstream.
+    """
+
+    def __init__(self, path_or_text: str, id_col: Optional[str] = None,
+                 inline: bool = False):
+        self._f = io.StringIO(path_or_text) if inline \
+            else open(path_or_text, newline="")
+        reader = csv.reader(self._f)
+        header = next(reader)
+        self._reader = reader
+        self._all_cols = _parse_header(header)
+        names = [n for n, _ in self._all_cols]
+        if id_col is None and "id" in names:
+            id_col = "id"
+        self._id_col = id_col
+
+    def schema(self):
+        return [(n, o) for n, o in self._all_cols if n != self._id_col]
+
+    def id_column(self):
+        return self._id_col
+
+    def records(self):
+        names = [n for n, _ in self._all_cols]
+        opts = {n: o for n, o in self._all_cols}
+        id_col = self._id_col
+        try:
+            for row in self._reader:
+                rec: Record = {}
+                for name, raw in zip(names, row):
+                    if name == id_col:
+                        # ids pass through uncoerced-ish: int when numeric
+                        rec[name] = int(raw) if raw.isdigit() else raw
+                    else:
+                        rec[name] = _coerce(raw, opts[name])
+                yield rec
+        finally:
+            self._f.close()

@@ -196,13 +196,22 @@ class SQLEngine:
             if bi.options.get("HEADER_ROW"):
                 next(rows, None)
             limit = bi.options.get("ROWSLIMIT")
+            allow_missing = bool(bi.options.get("ALLOW_MISSING_VALUES"))
             for rec in rows:
                 if limit is not None and n >= int(limit):
                     break
                 values = {}
                 for cname, (src, typ) in zip(cols, bi.map_defs):
-                    raw = rec[int(src)]
-                    values[cname] = _coerce(raw, typ)
+                    pos = int(src)
+                    if pos >= len(rec):
+                        if allow_missing:
+                            values[cname] = None
+                            continue
+                        raise SQLError(
+                            f"record {n + 1} has {len(rec)} values but MAP "
+                            f"references position {pos} (use "
+                            f"ALLOW_MISSING_VALUES to tolerate)")
+                    values[cname] = _coerce(rec[pos], typ)
                 self._upsert_record(idx, values)
                 n += 1
         return SQLResult(schema=[], data=[], changed=n)

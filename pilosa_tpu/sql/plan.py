@@ -321,6 +321,11 @@ class GroupByOp(PlanOp):
                 order.append(key)
             for st, (_, _, spec) in zip(groups[key], self._aggs):
                 st.add(env)
+        if not order and not self._groups:
+            # ungrouped aggregate over empty input still yields one row
+            # (COUNT=0, SUM/AVG/MIN/MAX NULL), per SQL semantics
+            yield [spec.new_state().result() for _, _, spec in self._aggs]
+            return
         for key in order:
             yield list(key) + [st.result() for st in groups[key]]
 
@@ -359,6 +364,19 @@ class AggState:
         f = self.spec
         if f.func == "COUNT":
             return len(self.distinct) if f.distinct else self.count
+        if f.distinct:
+            # numeric distinct aggregates reduce over the value set
+            vals = [v for v in self.distinct if isinstance(v, (int, float))]
+            if not vals:
+                return None
+            if f.func == "SUM":
+                return sum(vals)
+            if f.func == "AVG":
+                return sum(vals) / len(vals)
+            if f.func == "MIN":
+                return min(vals)
+            if f.func == "MAX":
+                return max(vals)
         if f.func == "SUM":
             return self.total if self.count else None
         if f.func == "AVG":

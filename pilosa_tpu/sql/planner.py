@@ -11,6 +11,7 @@ fall back to a host filter over the scan.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime as dt
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -32,6 +33,17 @@ class CannotLower(Exception):
     """Raised when a WHERE expression has no PQL/bitmap form."""
 
 
+class _QueryCtx:
+    """Per-query planning state (hidden ORDER BY columns, aggregate
+    naming). One instance per plan_select call so a shared Planner is
+    safe under the threaded HTTP server."""
+
+    def __init__(self):
+        self.hidden: list = []
+        self.agg_names: Dict[str, str] = {}
+        self.grp_rewrites: Dict[str, str] = {}  # repr(group expr) -> name
+
+
 class Planner:
     def __init__(self, api):
         self.api = api
@@ -41,16 +53,15 @@ class Planner:
     def plan_select(self, s: ast.SelectStatement) -> PlanOp:
         if s.table is None:
             return self._select_no_table(s)
-        self._hidden = []
-        self._agg_names: Dict[str, str] = {}
+        ctx = _QueryCtx()
         idx = self.api.holder.index(s.table)
         items = self._expand_star(idx, s.items)
         if s.group_by or any(_contains_agg(it.expr) for it in items):
-            op = self._plan_aggregate(idx, s, items)
+            op = self._plan_aggregate(idx, s, items, ctx)
         else:
-            op = self._plan_scan_select(idx, s, items)
+            op = self._plan_scan_select(idx, s, items, ctx)
         if s.order_by:
-            op = self._apply_order(op, s, items)
+            op = self._apply_order(op, s, items, ctx)
         if s.distinct:
             op = plan.DistinctOp(op)
         limit = s.limit if s.limit is not None else s.top
@@ -114,7 +125,8 @@ class Planner:
     # -- plain scan select ----------------------------------------------------
 
     def _plan_scan_select(self, idx: Index, s: ast.SelectStatement,
-                          items: List[ast.SelectItem]) -> PlanOp:
+                          items: List[ast.SelectItem],
+                          ctx: _QueryCtx) -> PlanOp:
         needed = set()
         for it in items:
             needed |= _columns_of(it.expr)
@@ -132,28 +144,31 @@ class Planner:
         proj = [(self._item_name(it, i), self._item_type(idx, it.expr), it.expr)
                 for i, it in enumerate(items)]
         # hidden order-by columns ride along; trimmed after the sort
-        self._hidden = []
         names = {p[0] for p in proj}
         for t in s.order_by:
             for c in _columns_of(t.expr):
                 if c not in names:
-                    self._hidden.append((c, self._item_type(idx, ast.ColumnRef(c)),
-                                         ast.ColumnRef(c)))
+                    ctx.hidden.append((c, self._item_type(idx, ast.ColumnRef(c)),
+                                       ast.ColumnRef(c)))
                     names.add(c)
-        return plan.ProjectOp(op, proj + self._hidden)
+        return plan.ProjectOp(op, proj + ctx.hidden)
 
     def _apply_order(self, op: PlanOp, s: ast.SelectStatement,
-                     items: List[ast.SelectItem]) -> PlanOp:
-        # aggregate terms (ORDER BY COUNT(*)) resolve to their computed
-        # columns via the same structural rewrite as projections
-        terms = [(_rewrite_aggs(t.expr, self._agg_names), t.desc)
-                 for t in s.order_by]
+                     items: List[ast.SelectItem], ctx: _QueryCtx) -> PlanOp:
+        # an ORDER BY term structurally equal to a projected item sorts by
+        # that output column; otherwise aggregates/group-exprs resolve via
+        # the same structural rewrites as projections
+        by_item = {repr(it.expr): self._item_name(it, i)
+                   for i, it in enumerate(items)}
+        terms = []
+        for t in s.order_by:
+            if repr(t.expr) in by_item:
+                terms.append((ast.ColumnRef(by_item[repr(t.expr)]), t.desc))
+            else:
+                terms.append((_rewrite_ctx(t.expr, ctx), t.desc))
         op = plan.OrderByOp(op, terms)
-        hidden = getattr(self, "_hidden", [])
-        if hidden:
-            keep = len(op.schema) - len(hidden)
-            op = _TrimOp(op, keep)
-            self._hidden = []
+        if ctx.hidden:
+            op = _TrimOp(op, len(op.schema) - len(ctx.hidden))
         return op
 
     # -- scan (PQL Extract bridge) --------------------------------------------
@@ -222,7 +237,7 @@ class Planner:
                 return self._lower_cmp(idx, e)
             raise CannotLower(e.op)
         if isinstance(e, ast.Unary) and e.op == "NOT":
-            return Call("Not", children=[self.lower_filter(idx, e.operand)])
+            return self._lower_not(idx, e.operand)
         if isinstance(e, ast.InList):
             col, vals = _col_and_literals(e.operand, e.items)
             if col is None:
@@ -240,8 +255,13 @@ class Planner:
                 raise CannotLower("BETWEEN")
             lo, hi = _literal(e.low), _literal(e.high)
             f = self._bsi_field(idx, e.operand.name)
-            c = Call("Row", {f.name: Condition("between", [lo, hi])})
-            return Call("Not", children=[c]) if e.negated else c
+            if e.negated:
+                # NOT BETWEEN = < lo OR > hi; BSI compares exclude NULL
+                # rows, preserving three-valued logic
+                return Call("Union", children=[
+                    Call("Row", {f.name: Condition("<", lo)}),
+                    Call("Row", {f.name: Condition(">", hi)})])
+            return Call("Row", {f.name: Condition("between", [lo, hi])})
         if isinstance(e, ast.IsNull):
             if not isinstance(e.operand, ast.ColumnRef):
                 raise CannotLower("IS NULL")
@@ -302,6 +322,38 @@ class Planner:
                                   Call("Row", {col: lit})])
         raise CannotLower(f"{t.value} {op}")
 
+    def _lower_not(self, idx: Index, e: ast.Expr) -> Call:
+        """Lower NOT <expr> with SQL three-valued logic: push the negation
+        down to the leaves (De Morgan is exact in 3VL), where each negated
+        comparison excludes NULL rows the same way != does."""
+        if isinstance(e, ast.Unary) and e.op == "NOT":
+            return self.lower_filter(idx, e.operand)
+        if isinstance(e, ast.Binary) and e.op == "AND":
+            return Call("Union", children=[self._lower_not(idx, e.left),
+                                           self._lower_not(idx, e.right)])
+        if isinstance(e, ast.Binary) and e.op == "OR":
+            return Call("Intersect", children=[self._lower_not(idx, e.left),
+                                               self._lower_not(idx, e.right)])
+        if isinstance(e, ast.Binary) and e.op in ("=", "!=", "<", "<=",
+                                                  ">", ">="):
+            neg = {"=": "!=", "!=": "=", "<": ">=", "<=": ">",
+                   ">": "<", ">=": "<="}[e.op]
+            return self.lower_filter(idx, ast.Binary(neg, e.left, e.right))
+        if isinstance(e, (ast.InList, ast.Between, ast.IsNull, ast.Like)):
+            return self.lower_filter(
+                idx, dataclasses.replace(e, negated=not e.negated))
+        if isinstance(e, ast.ColumnRef):
+            field = idx.field(e.name)
+            if field.options.type == FieldType.BOOL:
+                return Call("Row", {e.name: False})
+            raise CannotLower("bare column")
+        if isinstance(e, ast.FuncCall) and e.name in (
+                "SETCONTAINS", "SETCONTAINSANY", "SETCONTAINSALL"):
+            # SETCONTAINS on an empty set is False (not NULL) in the host
+            # eval too, so NOT complements within existence
+            return Call("Not", children=[self._lower_func(idx, e)])
+        raise CannotLower(f"NOT {type(e).__name__}")
+
     def _notnull_call(self, idx: Index, col: str) -> Call:
         field = idx.field(col)
         if field.options.type.is_bsi:
@@ -339,17 +391,18 @@ class Planner:
     # -- aggregate queries -----------------------------------------------------
 
     def _plan_aggregate(self, idx: Index, s: ast.SelectStatement,
-                        items: List[ast.SelectItem]) -> PlanOp:
+                        items: List[ast.SelectItem],
+                        ctx: _QueryCtx) -> PlanOp:
         aggs = _collect_aggs(items, s.having, s.order_by)
         if s.group_by:
-            return self._plan_groupby(idx, s, items, aggs)
+            return self._plan_groupby(idx, s, items, aggs, ctx)
         # no GROUP BY: single output row, each aggregate is one kernel query
         filter_call, host_pred = self._split_filter(idx, s.where)
         if host_pred is not None:
-            return self._plan_host_aggregate(idx, s, items, aggs)
+            return self._plan_host_aggregate(idx, s, items, aggs, ctx)
         executor = self.api.executor
-        agg_names = self._name_aggs(aggs)
-        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        agg_names = self._name_aggs(aggs, ctx)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by, ctx)
         schema = [(self._item_name(it, i), self._item_type(idx, it.expr))
                   for i, it in enumerate(items)]
         schema += [(n, t) for n, t, _ in hidden]
@@ -369,27 +422,27 @@ class Planner:
 
         return CallbackOp(schema, thunk, name="PQLAggregate")
 
-    def _name_aggs(self, aggs: List[ast.FuncCall]) -> Dict[str, str]:
-        names = {_agg_key(a): f"__agg{i}" for i, a in enumerate(aggs)}
-        self._agg_names = names
-        return names
+    def _name_aggs(self, aggs: List[ast.FuncCall],
+                   ctx: _QueryCtx) -> Dict[str, str]:
+        ctx.agg_names = {_agg_key(a): f"__agg{i}" for i, a in enumerate(aggs)}
+        return ctx.agg_names
 
     def _hidden_agg_items(self, idx: Index, items: List[ast.SelectItem],
                           aggs: List[ast.FuncCall],
-                          order_by: List[ast.OrderTerm]):
+                          order_by: List[ast.OrderTerm], ctx: _QueryCtx):
         """Aggregates referenced only by ORDER BY ride along as hidden
         output columns and are trimmed after the sort."""
         if not order_by:
-            self._hidden = []
+            ctx.hidden = []
             return []
         # every aggregate rides along under its __aggN name so rewritten
         # ORDER BY terms always resolve (projected copies may be aliased)
         hidden = []
         for a in aggs:
-            name = self._agg_names[_agg_key(a)]
+            name = ctx.agg_names[_agg_key(a)]
             hidden.append((name, self._item_type(idx, a),
                            ast.ColumnRef(name)))
-        self._hidden = hidden
+        ctx.hidden = hidden
         return hidden
 
     def _run_agg(self, idx: Index, a: ast.FuncCall,
@@ -401,6 +454,20 @@ class Planner:
         def run(call: Call):
             return executor.execute(idx.name, Query([call]))[0]
 
+        if a.distinct and a.name in ("SUM", "AVG", "MIN", "MAX"):
+            # distinct numeric aggregates: reduce over the Distinct values
+            col = _agg_col(a)
+            if not idx.field(col).options.type.is_bsi:
+                raise SQLError(f"{a.name}(DISTINCT) requires an int-like column")
+            vals = run(Call("Distinct", {"_field": col},
+                            children=[filter_call] if filter_call else []))
+            if not vals:
+                return None
+            if a.name == "SUM":
+                return sum(vals)
+            if a.name == "AVG":
+                return sum(vals) / len(vals)
+            return min(vals) if a.name == "MIN" else max(vals)
         if a.name == "COUNT":
             if a.distinct:
                 col = _agg_col(a)
@@ -446,18 +513,18 @@ class Planner:
 
     def _plan_groupby(self, idx: Index, s: ast.SelectStatement,
                       items: List[ast.SelectItem],
-                      aggs: List[ast.FuncCall]) -> PlanOp:
+                      aggs: List[ast.FuncCall], ctx: _QueryCtx) -> PlanOp:
         group_cols: List[str] = []
         for g in s.group_by:
             if not isinstance(g, ast.ColumnRef):
-                return self._plan_host_aggregate(idx, s, items, aggs)
+                return self._plan_host_aggregate(idx, s, items, aggs, ctx)
             group_cols.append(g.name)
         filter_call, host_pred = self._split_filter(idx, s.where)
         fast = host_pred is None and self._groupby_fast_ok(idx, group_cols, aggs)
         if not fast:
-            return self._plan_host_aggregate(idx, s, items, aggs)
+            return self._plan_host_aggregate(idx, s, items, aggs, ctx)
         return self._plan_pql_groupby(idx, s, items, aggs, group_cols,
-                                      filter_call)
+                                      filter_call, ctx)
 
     def _groupby_fast_ok(self, idx: Index, group_cols: List[str],
                          aggs: List[ast.FuncCall]) -> bool:
@@ -472,7 +539,8 @@ class Planner:
             if a.name == "COUNT" and not a.distinct and a.args and \
                     isinstance(a.args[0], ast.Star):
                 continue
-            if a.name == "SUM" and isinstance(a.args[0], ast.ColumnRef):
+            if a.name == "SUM" and not a.distinct and \
+                    isinstance(a.args[0], ast.ColumnRef):
                 sum_cols.add(a.args[0].name)
                 continue
             return False
@@ -481,12 +549,13 @@ class Planner:
     def _plan_pql_groupby(self, idx: Index, s: ast.SelectStatement,
                           items: List[ast.SelectItem],
                           aggs: List[ast.FuncCall], group_cols: List[str],
-                          filter_call: Optional[Call]) -> PlanOp:
+                          filter_call: Optional[Call],
+                          ctx: _QueryCtx) -> PlanOp:
         """GroupBy on the kernel engine (reference:
         sql3/planner/oppqlgroupby.go + oppqlmultigroupby fusion)."""
         executor = self.api.executor
-        agg_names = self._name_aggs(aggs)
-        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        agg_names = self._name_aggs(aggs, ctx)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by, ctx)
         sum_col = next((a.args[0].name for a in aggs if a.name == "SUM"), None)
         gfields = [idx.field(c) for c in group_cols]
         schema = [(self._item_name(it, i), self._item_type(idx, it.expr))
@@ -532,7 +601,8 @@ class Planner:
 
     def _plan_host_aggregate(self, idx: Index, s: ast.SelectStatement,
                              items: List[ast.SelectItem],
-                             aggs: List[ast.FuncCall]) -> PlanOp:
+                             aggs: List[ast.FuncCall],
+                             ctx: _QueryCtx) -> PlanOp:
         """Fallback: scan + host grouping (reference: opgroupby.go when
         PQL fusion doesn't apply)."""
         needed = set()
@@ -548,13 +618,22 @@ class Planner:
         scan: PlanOp = self._scan_op(idx, sorted(needed - {"_id"}), filter_call)
         if host_pred is not None:
             scan = plan.FilterOp(scan, host_pred)
-        group_names = []
-        for g in s.group_by:
-            if not isinstance(g, ast.ColumnRef):
-                raise SQLError("GROUP BY supports plain columns")
-            group_names.append(g.name)
-        agg_names = self._name_aggs(aggs)
-        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by)
+        # expression group keys become computed ride-along columns
+        group_names: List[str] = []
+        computed: List[tuple] = []
+        for i, g in enumerate(s.group_by):
+            if isinstance(g, ast.ColumnRef):
+                group_names.append(g.name)
+            else:
+                name = f"__grp{i}"
+                ctx.grp_rewrites[repr(g)] = name
+                computed.append((name, self._item_type(idx, g), g))
+                group_names.append(name)
+        if computed:
+            passthrough = [(n, t, ast.ColumnRef(n)) for n, t in scan.schema]
+            scan = plan.ProjectOp(scan, passthrough + computed)
+        agg_names = self._name_aggs(aggs, ctx)
+        hidden = self._hidden_agg_items(idx, items, aggs, s.order_by, ctx)
         specs = []
         for a in aggs:
             expr = None if (a.args and isinstance(a.args[0], ast.Star)) \
@@ -563,9 +642,9 @@ class Planner:
                           AggSpec(a.name, expr, distinct=a.distinct)))
         op: PlanOp = plan.GroupByOp(scan, group_names, specs)
         if s.having is not None:
-            op = plan.FilterOp(op, _rewrite_aggs(s.having, agg_names))
+            op = plan.FilterOp(op, _rewrite_ctx(s.having, ctx))
         proj = [(self._item_name(it, i), self._item_type(idx, it.expr),
-                 _rewrite_aggs(it.expr, agg_names))
+                 _rewrite_ctx(it.expr, ctx))
                 for i, it in enumerate(items)] + hidden
         return plan.ProjectOp(op, proj)
 
@@ -661,6 +740,22 @@ def _collect_aggs(items: List[ast.SelectItem], having: Optional[ast.Expr],
     for t in order_by:
         walk(t.expr)
     return out
+
+
+def _rewrite_ctx(e: ast.Expr, ctx: "_QueryCtx") -> ast.Expr:
+    """Replace group-key expressions and aggregates with refs to their
+    computed columns (both matched structurally)."""
+    if repr(e) in ctx.grp_rewrites:
+        return ast.ColumnRef(ctx.grp_rewrites[repr(e)])
+    if isinstance(e, ast.FuncCall) and e.name in AGGS and \
+            _agg_key(e) in ctx.agg_names:
+        return ast.ColumnRef(ctx.agg_names[_agg_key(e)])
+    if isinstance(e, ast.Binary):
+        return ast.Binary(e.op, _rewrite_ctx(e.left, ctx),
+                          _rewrite_ctx(e.right, ctx))
+    if isinstance(e, ast.Unary):
+        return ast.Unary(e.op, _rewrite_ctx(e.operand, ctx))
+    return e
 
 
 def _rewrite_aggs(e: ast.Expr, names: Dict[str, str]) -> ast.Expr:

@@ -1,0 +1,153 @@
+"""Ingest kit tests (reference patterns: batch/batch_test.go,
+idk ingest tests, idalloc tests)."""
+
+import os
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.core.schema import FieldOptions, FieldType
+from pilosa_tpu.ingest import (Batch, CSVSource, IDAllocator, Ingester,
+                               ListSource)
+
+
+@pytest.fixture()
+def api():
+    a = API()
+    a.create_index("i")
+    idx = a.holder.index("i")
+    idx.create_field("color", FieldOptions(type=FieldType.SET, keys=True))
+    idx.create_field("size", FieldOptions(type=FieldType.MUTEX, keys=True))
+    idx.create_field("age", FieldOptions(type=FieldType.INT))
+    idx.create_field("active", FieldOptions(type=FieldType.BOOL))
+    return a
+
+
+def count(api, pql):
+    return api.query("i", pql)[0]
+
+
+def test_batch_basic(api):
+    b = Batch(api, "i", size=3)
+    flushed = b.add({"id": 1, "color": ["red", "blue"], "age": 10})
+    assert not flushed
+    b.add({"id": 2, "color": ["red"], "size": "L", "active": True})
+    flushed = b.add({"id": 1 << 20, "age": -5})  # second shard
+    assert flushed  # auto-flush at size
+    assert b.imported == 3 and len(b) == 0
+    assert count(api, "Count(Row(color=red))") == 2
+    assert count(api, "Count(Row(color=blue))") == 1
+    assert api.query("i", "Sum(field=age)")[0].val == 5
+    assert count(api, "Count(Row(active=true))") == 1
+    assert count(api, "Count(All())") == 3
+
+
+def test_batch_mutex_scalar(api):
+    b = Batch(api, "i", size=10)
+    b.add({"id": 7, "size": "S"})
+    b.flush()
+    b.add({"id": 7, "size": "M"})  # mutex overwrite
+    b.flush()
+    assert count(api, "Count(Row(size=M))") == 1
+    assert count(api, "Count(Row(size=S))") == 0
+
+
+def test_batch_keyed_index():
+    api = API()
+    api.create_index("k", {"keys": True})
+    api.holder.index("k").create_field(
+        "color", FieldOptions(type=FieldType.SET, keys=True))
+    b = Batch(api, "k", size=10)
+    b.add({"id": "userA", "color": ["red"]})
+    b.add({"id": "userB", "color": ["red"]})
+    b.flush()
+    r = api.query("k", "Row(color=red)")[0]
+    assert sorted(r.keys) == ["userA", "userB"]
+
+
+def test_idalloc_sessions(tmp_path):
+    path = str(tmp_path / "ids.journal")
+    a = IDAllocator(path)
+    r1 = a.reserve("s1", 100, offset=0)
+    assert (r1.base, r1.count) == (1, 100)
+    # same session+offset replays the same range (crash retry)
+    again = a.reserve("s1", 100, offset=0)
+    assert again.base == r1.base
+    r2 = a.reserve("s2", 10, offset=0)
+    assert r2.base == r1.end
+    a.commit("s1")
+    # reload from journal: next id preserved
+    b = IDAllocator(path)
+    r3 = b.reserve("s3", 5, offset=0)
+    assert r3.base >= r2.end
+
+
+def test_idalloc_commit_returns_tail():
+    a = IDAllocator()
+    r = a.reserve("s", 1000)
+    a.commit("s", count=10)  # only used 10
+    r2 = a.reserve("t", 5)
+    assert r2.base == r.base + 10
+
+
+def test_csv_source_typed_header(api, tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "id,name__S,age__I,tags__SS,ok__B,price__F2\n"
+        "1,alice,30,a;b,true,9.99\n"
+        "2,bob,40,b,false,1.50\n"
+        "3,carol,,c;d,true,\n")
+    src = CSVSource(str(p))
+    ing = Ingester(api, "csvidx", src, batch_size=2)
+    assert ing.run() == 3
+    a = api
+    assert a.query("csvidx", "Count(Row(tags=b))")[0] == 2
+    assert a.query("csvidx", "Sum(field=age)")[0].val == 70
+    assert a.query("csvidx", "Count(Row(name=carol))")[0] == 1
+    # decimal scale applied
+    assert abs(a.query("csvidx", "Max(field=price)")[0].val - 9.99) < 1e-9
+
+
+def test_ingester_auto_id(api):
+    schema = [("color", FieldOptions(type=FieldType.SET, keys=True))]
+    src = ListSource(schema, [{"color": ["x"]}, {"color": ["x", "y"]}],
+                     id_col=None)
+    ing = Ingester(api, "autoidx", src, batch_size=10)
+    assert ing.run() == 2
+    assert api.query("autoidx", "Count(Row(color=x))")[0] == 2
+    r = api.query("autoidx", "Row(color=y)")[0]
+    assert len(r.columns) == 1
+
+
+def test_ingester_schema_inference(api):
+    src = CSVSource("id,city__S,pop__I\n9,nyc,8000000\n", inline=True)
+    Ingester(api, "inferidx", src).run()
+    idx = api.holder.index("inferidx")
+    assert idx.field("city").options.keys
+    assert idx.field("pop").options.type == FieldType.INT
+    assert api.query("inferidx", "Count(Row(city=nyc))")[0] == 1
+
+
+def test_kafka_source_gated_and_fake(api):
+    # gated: no kafka client in the image
+    from pilosa_tpu.ingest.kafka import KafkaSource
+
+    class FakeConsumer:
+        def __iter__(self):
+            import json as j
+
+            class M:
+                def __init__(self, v):
+                    self.value = v
+            for v in [{"id": 1, "color": ["red"]}, {"id": 2, "color": ["blue"]}]:
+                yield M(j.dumps(v))
+
+    class FakeClient:
+        def KafkaConsumer(self, *a, **k):
+            return FakeConsumer()
+
+    src = KafkaSource("localhost:9092", ["t"], "g",
+                      fields=["id", "color__SS"], client=FakeClient())
+    ing = Ingester(api, "kafkaidx", src)
+    assert ing.run() == 2
+    assert api.query("kafkaidx", "Count(Row(color=red))")[0] == 1

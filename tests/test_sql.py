@@ -307,3 +307,55 @@ def test_neq_excludes_null(eng):
     # BSI != also excludes null
     eng.query("INSERT INTO nulls (_id, name) VALUES (4, 'y')")
     assert q(eng, "SELECT _id FROM nulls WHERE age != 99") == [[2], [3]]
+
+
+def test_not_three_valued_logic(eng):
+    eng.query("CREATE TABLE n2 (_id ID, name STRING, age INT)")
+    eng.query("INSERT INTO n2 (_id, age) VALUES (2, 30)")
+    eng.query("INSERT INTO n2 (_id, name, age) VALUES (3, 'x', 40)")
+    # NOT over NULL row behaves like != (De Morgan push-down)
+    assert q(eng, "SELECT _id FROM n2 WHERE NOT name = 'zzz'") == [[3]]
+    assert q(eng, "SELECT _id FROM n2 WHERE NOT (name = 'zzz' OR age = 30)") \
+        == [[3]]
+    assert q(eng, "SELECT _id FROM n2 WHERE age NOT BETWEEN 35 AND 50") == [[2]]
+    assert q(eng, "SELECT _id FROM n2 WHERE NOT age BETWEEN 35 AND 50") == [[2]]
+    assert q(eng, "SELECT _id FROM n2 WHERE NOT NOT age = 30") == [[2]]
+    assert q(eng, "SELECT _id FROM n2 WHERE NOT name IS NULL") == [[3]]
+
+
+def test_empty_ungrouped_host_aggregate(eng):
+    got = q(eng, "SELECT COUNT(*), SUM(amount), AVG(amount) FROM orders "
+                 "WHERE amount % 2 = 1")
+    assert got == [[0, None, None]]
+
+
+def test_distinct_numeric_aggregates(eng):
+    eng.query("INSERT INTO orders (_id, region, amount) VALUES (6, 'east', 100)")
+    # amounts now 100,200,300,400,500,100 -> distinct sum 1500, plain 1600
+    assert q(eng, "SELECT SUM(amount) FROM orders") == [[1600]]
+    assert q(eng, "SELECT SUM(DISTINCT amount) FROM orders") == [[1500]]
+    assert q(eng, "SELECT AVG(DISTINCT amount) FROM orders") == [[300.0]]
+    got = q(eng, "SELECT region, SUM(DISTINCT amount) FROM orders "
+                 "GROUP BY region")
+    assert ["east", 900] in got  # 100,300,500,100 -> distinct 900
+
+
+def test_group_by_expression(eng):
+    got = q(eng, "SELECT amount / 200, COUNT(*) FROM orders "
+                 "GROUP BY amount / 200 ORDER BY amount / 200")
+    # amounts 100..500 -> 0:1(100), 1:2(200,300), 2:2(400,500)
+    assert got == [[0, 1], [1, 2], [2, 2]]
+
+
+def test_bulk_insert_missing_values(eng):
+    eng.query("CREATE TABLE bm (_id ID, a STRING, b INT)")
+    data = "1,x,5\\n2,y"
+    import pytest as _pt
+    with _pt.raises(Exception):
+        eng.query("BULK INSERT INTO bm (_id, a, b) MAP (0 ID, 1 STRING, 2 INT) "
+                  "FROM '1,x,5\n2,y' WITH FORMAT 'CSV' INPUT 'STREAM'")
+    r = eng.query("BULK INSERT INTO bm (_id, a, b) MAP (0 ID, 1 STRING, 2 INT) "
+                  "FROM '1,x,5\n2,y' WITH FORMAT 'CSV' INPUT 'STREAM' "
+                  "ALLOW_MISSING_VALUES")
+    assert r.changed == 2
+    assert q(eng, "SELECT b FROM bm WHERE _id = 2") == [[None]]
