@@ -95,7 +95,7 @@ class API:
                     rows: Sequence[int], cols: Sequence[int],
                     row_keys: Optional[Sequence[str]] = None,
                     col_keys: Optional[Sequence[str]] = None,
-                    clear: bool = False) -> int:
+                    clear: bool = False, remote: bool = False) -> int:
         """Bulk (row, col) import, translating keys when given (the analog
         of the reference's ImportRequest with RowKeys/ColumnKeys)."""
         idx = self.holder.index(index)
@@ -142,7 +142,8 @@ class API:
 
     def import_values(self, index: str, field: str,
                       cols: Sequence[int], values: Sequence,
-                      col_keys: Optional[Sequence[str]] = None) -> int:
+                      col_keys: Optional[Sequence[str]] = None,
+                      remote: bool = False) -> int:
         """Bulk BSI import (reference: api.go ImportValue ->
         fragment.importValue)."""
         idx = self.holder.index(index)
@@ -168,7 +169,8 @@ class API:
         return len(cols)
 
     def import_roaring(self, index: str, field: str, shard: int,
-                       views: Dict[str, bytes], clear: bool = False) -> None:
+                       views: Dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
         """Shard-transactional roaring import (reference: api.go:1647
         ImportRoaringShard): per view, a pilosa-roaring blob addressed as
         row*ShardWidth + column within the shard; merged (or cleared) into

@@ -1,0 +1,29 @@
+"""Cluster layer: membership, placement, node-to-node RPC, distributed
+execution (SURVEY.md §2.3).
+
+The multi-host axis of the engine: shards hash to partitions (fnv64a),
+partitions jump-hash to nodes, queries fan out to shard primaries and
+reduce at the coordinator, writes replicate to all owners. Within a
+host, shards spread over the TPU device mesh instead
+(pilosa_tpu/parallel)."""
+
+from pilosa_tpu.cluster.broadcast import (  # noqa: F401
+    Broadcaster, HTTPBroadcaster, NopBroadcaster,
+)
+from pilosa_tpu.cluster.client import (  # noqa: F401
+    InternalClient, NodeDownError, RemoteError,
+)
+from pilosa_tpu.cluster.disco import (  # noqa: F401
+    DisCo, InMemDisCo, SingleNodeDisCo, StaticDisCo,
+)
+from pilosa_tpu.cluster.executor import ClusterExecutor  # noqa: F401
+from pilosa_tpu.cluster.harness import LocalCluster  # noqa: F401
+from pilosa_tpu.hashing import (  # noqa: F401
+    fnv64a, jump_hash, key_to_partition, shard_to_partition,
+)
+from pilosa_tpu.cluster.node import ClusterNode  # noqa: F401
+from pilosa_tpu.errors import ClusterStateError  # noqa: F401
+from pilosa_tpu.cluster.topology import (  # noqa: F401
+    ClusterSnapshot, Node, STATE_DEGRADED, STATE_DOWN, STATE_NORMAL,
+)
+from pilosa_tpu.cluster.translator import ClusterTranslator  # noqa: F401

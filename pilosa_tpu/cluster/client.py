@@ -1,0 +1,148 @@
+"""InternalClient: node-to-node RPC over HTTP+JSON.
+
+Reference: internal_client.go — the "NCCL" of the reference cluster
+(SURVEY.md §5.8): query fan-out (QueryNode :602), import forwarding
+(:691-931), translate-key RPCs, peer status. Retries with backoff like
+retryablehttp (internal_client.go:1744). ConnectionError is surfaced as
+NodeDownError so the executor can fail over to replicas
+(executor.go:6500-6515).
+
+Within one host the TPU engine never uses this path — shards on the
+local mesh reduce via XLA collectives; this client only carries
+host-to-host traffic (and the control plane).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+
+class NodeDownError(ConnectionError):
+    """The peer did not answer at the transport level — retarget replicas."""
+
+
+class RemoteError(RuntimeError):
+    """The peer answered with an application error (4xx/5xx)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"remote status {status}: {message}")
+        self.status = status
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0, retries: int = 2,
+                 backoff: float = 0.05):
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None,
+                 ctype: str = "application/json") -> dict:
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(url, data=body, method=method)
+            if body is not None:
+                req.add_header("Content-Type", ctype)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    data = resp.read()
+                    return json.loads(data) if data else {}
+            except urllib.error.HTTPError as e:
+                msg = e.read().decode(errors="replace")
+                try:
+                    msg = json.loads(msg).get("error", msg)
+                except Exception:
+                    pass
+                raise RemoteError(e.code, msg) from None
+            except (urllib.error.URLError, socket.timeout, OSError) as e:
+                last = e
+                if attempt < self.retries:
+                    time.sleep(self.backoff * (2 ** attempt))
+        raise NodeDownError(str(last))
+
+    def _post(self, node, path: str, payload: dict) -> dict:
+        return self._request("POST", node.uri + path,
+                             json.dumps(payload).encode())
+
+    def _get(self, node, path: str) -> dict:
+        return self._request("GET", node.uri + path)
+
+    # -- query fan-out (reference: internal_client.go:602 QueryNode) -------
+
+    def query_node(self, node, index: str, pql: str,
+                   shards: Sequence[int]) -> List[dict]:
+        """Run `pql` for the given shards on a peer; results come back as
+        wire-tagged JSON (pql/result.py result_to_wire)."""
+        out = self._post(node, f"/internal/index/{index}/query", {
+            "query": pql, "shards": list(shards), "remote": True,
+        })
+        return out["results"]
+
+    # -- imports (reference: internal_client.go:691-931) -------------------
+
+    def import_bits(self, node, index: str, field: str, payload: dict) -> dict:
+        return self._post(node, f"/index/{index}/import", payload)
+
+    def import_values(self, node, index: str, field: str, payload: dict) -> dict:
+        return self._post(node, f"/index/{index}/import-values", payload)
+
+    def import_roaring_shard(self, node, index: str, shard: int,
+                             payload: dict) -> dict:
+        return self._post(
+            node, f"/index/{index}/shard/{shard}/import-roaring", payload)
+
+    # -- translation (reference: cluster.go:233-887 key RPC loops) ---------
+
+    def create_index_keys(self, node, index: str, keys: List[str]) -> Dict[str, int]:
+        out = self._post(node, f"/internal/translate/index/{index}/keys/create",
+                         {"keys": keys})
+        return {k: int(v) for k, v in out["ids"].items()}
+
+    def find_index_keys(self, node, index: str, keys: List[str]) -> Dict[str, int]:
+        out = self._post(node, f"/internal/translate/index/{index}/keys/find",
+                         {"keys": keys})
+        return {k: int(v) for k, v in out["ids"].items()}
+
+    def translate_index_ids(self, node, index: str, ids: List[int]) -> Dict[int, str]:
+        out = self._post(node, f"/internal/translate/index/{index}/ids",
+                         {"ids": list(ids)})
+        return {int(k): v for k, v in out["keys"].items()}
+
+    def create_field_keys(self, node, index: str, field: str,
+                          keys: List[str]) -> Dict[str, int]:
+        out = self._post(
+            node, f"/internal/translate/field/{index}/{field}/keys/create",
+            {"keys": keys})
+        return {k: int(v) for k, v in out["ids"].items()}
+
+    def find_field_keys(self, node, index: str, field: str,
+                        keys: List[str]) -> Dict[str, int]:
+        out = self._post(
+            node, f"/internal/translate/field/{index}/{field}/keys/find",
+            {"keys": keys})
+        return {k: int(v) for k, v in out["ids"].items()}
+
+    def translate_field_ids(self, node, index: str, field: str,
+                            ids: List[int]) -> Dict[int, str]:
+        out = self._post(node, f"/internal/translate/field/{index}/{field}/ids",
+                         {"ids": list(ids)})
+        return {int(k): v for k, v in out["keys"].items()}
+
+    # -- control plane -----------------------------------------------------
+
+    def send_message(self, node, msg: dict) -> None:
+        self._post(node, "/internal/cluster/message", msg)
+
+    def status(self, node) -> Optional[dict]:
+        """None when the node is unreachable (used as the liveness probe)."""
+        try:
+            return self._get(node, "/status")
+        except (NodeDownError, RemoteError):
+            return None

@@ -1,0 +1,143 @@
+"""DisCo — distributed consensus facade: membership + shared schema.
+
+Reference: disco/disco.go:35 (DisCo iface), :92 (Schemator), with the
+production impl on embedded etcd (etcd/embed.go:190) and in-memory fakes
+for tests (disco/disco.go:161-281). The TPU build is SPMD
+single-controller per host, so membership needs are lighter: a
+StaticDisCo (peer list from config, liveness probed over HTTP) covers
+multi-host, and InMemDisCo backs the in-process test harness — the
+analog of the reference's test.MustRunCluster etcd-in-process setup
+(test/cluster.go:748).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from pilosa_tpu.cluster.topology import (
+    Node, NODE_STATE_STARTED, ClusterSnapshot, STATE_NORMAL,
+)
+
+
+class DisCo:
+    """Membership + schema-broadcast interface."""
+
+    def nodes(self) -> List[Node]:
+        raise NotImplementedError
+
+    def live_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def snapshot(self, replica_n: int = 1) -> ClusterSnapshot:
+        return ClusterSnapshot(self.nodes(), replica_n=replica_n)
+
+    def cluster_state(self, replica_n: int = 1) -> str:
+        return self.snapshot(replica_n).cluster_state(self.live_ids())
+
+
+class InMemDisCo(DisCo):
+    """Shared-memory membership for in-process clusters (reference:
+    disco.NewInMemDisCo, disco/disco.go:161). One instance is shared by
+    every node in the process; ``down()``/``up()`` simulate failures the
+    way clustertests pause containers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Node] = {}
+        self._live: Dict[str, bool] = {}
+
+    def register(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.id] = node
+            self._live[node.id] = True
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self._live.pop(node_id, None)
+
+    def down(self, node_id: str) -> None:
+        with self._lock:
+            self._live[node_id] = False
+
+    def up(self, node_id: str) -> None:
+        with self._lock:
+            self._live[node_id] = True
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return sorted(self._nodes.values(), key=lambda n: n.id)
+
+    def live_ids(self) -> List[str]:
+        with self._lock:
+            return [i for i, ok in self._live.items() if ok]
+
+    def is_live(self, node_id: str) -> bool:
+        with self._lock:
+            return self._live.get(node_id, False)
+
+
+class StaticDisCo(DisCo):
+    """Config-listed peers with cached HTTP liveness probes — the
+    multi-host mode when no consensus service is wanted. Liveness is
+    learned lazily: a probe function (typically InternalClient.status)
+    is consulted at most every ``probe_interval`` seconds per node, and
+    the executor also marks nodes down on connection errors (the same
+    signal the reference uses, executor.go:6500)."""
+
+    def __init__(self, nodes: List[Node],
+                 probe: Optional[Callable[[Node], bool]] = None,
+                 probe_interval: float = 5.0):
+        self._nodes = sorted(nodes, key=lambda n: n.id)
+        self._probe = probe
+        self._interval = probe_interval
+        self._lock = threading.Lock()
+        self._state: Dict[str, bool] = {n.id: True for n in self._nodes}
+        self._checked: Dict[str, float] = {}
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes)
+
+    def live_ids(self) -> List[str]:
+        now = time.monotonic()
+        out = []
+        for n in self._nodes:
+            with self._lock:
+                last = self._checked.get(n.id, 0.0)
+                live = self._state.get(n.id, True)
+            if self._probe is not None and now - last > self._interval:
+                live = bool(self._probe(n))
+                with self._lock:
+                    self._state[n.id] = live
+                    self._checked[n.id] = now
+            if live:
+                out.append(n.id)
+        return out
+
+    def mark_down(self, node_id: str) -> None:
+        with self._lock:
+            self._state[node_id] = False
+            self._checked[node_id] = time.monotonic()
+
+    def mark_up(self, node_id: str) -> None:
+        with self._lock:
+            self._state[node_id] = True
+            self._checked[node_id] = time.monotonic()
+
+
+class SingleNodeDisCo(DisCo):
+    """The degenerate one-node cluster (default for embedded use)."""
+
+    def __init__(self, node: Optional[Node] = None):
+        self._node = node or Node(id="local", uri="")
+
+    def nodes(self) -> List[Node]:
+        return [self._node]
+
+    def live_ids(self) -> List[str]:
+        return [self._node.id]
+
+    def cluster_state(self, replica_n: int = 1) -> str:
+        return STATE_NORMAL

@@ -1,0 +1,71 @@
+"""In-process multi-node cluster harness.
+
+Reference: test/cluster.go:748 MustRunCluster — N real servers in one
+process on ephemeral ports, sharing an in-memory membership fake
+(disco.NewInMemDisCo). Inter-node traffic goes over real HTTP loopback
+sockets, so the full RPC/broadcast/translation path is exercised.
+``pause``/``unpause`` mirror the clustertests' container pause
+(internal/clustertests/pause_node_test.go).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from pilosa_tpu.cluster.disco import InMemDisCo
+from pilosa_tpu.cluster.node import ClusterNode
+from pilosa_tpu.server.http import serve
+
+
+class LocalCluster:
+    def __init__(self, n: int, replica_n: int = 1,
+                 base_path: Optional[str] = None):
+        self.disco = InMemDisCo()
+        self.nodes: List[ClusterNode] = []
+        self._servers = []
+        for i in range(n):
+            path = os.path.join(base_path, f"node{i}") if base_path else None
+            if path:
+                os.makedirs(path, exist_ok=True)
+            node = ClusterNode(f"node{i}", "", self.disco, path=path,
+                               replica_n=replica_n)
+            srv, _ = serve(node, port=0, background=True)
+            host, port = srv.server_address[:2]
+            node.node.uri = f"http://{host}:{port}"
+            self.nodes.append(node)
+            self._servers.append(srv)
+
+    def __getitem__(self, i: int) -> ClusterNode:
+        return self.nodes[i]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def coordinator(self) -> ClusterNode:
+        return self.nodes[0]
+
+    def pause(self, i: int) -> None:
+        """Make node i unreachable (keeps its data, like SIGSTOP on a
+        container). The listener closes so peers get connection-refused
+        rather than hangs."""
+        self._servers[i].shutdown()
+        self._servers[i].server_close()
+        self.disco.down(f"node{i}")
+
+    def unpause(self, i: int) -> None:
+        node = self.nodes[i]
+        srv, _ = serve(node, port=0, background=True)
+        host, port = srv.server_address[:2]
+        node.node.uri = f"http://{host}:{port}"
+        self._servers[i] = srv
+        self.disco.up(f"node{i}")
+
+    def close(self) -> None:
+        for srv in self._servers:
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except Exception:
+                pass

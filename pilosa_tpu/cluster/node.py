@@ -1,0 +1,325 @@
+"""ClusterNode: one engine process participating in a cluster.
+
+Reference: the Server object (server.go:46) + API state gating
+(api.go:160-187) + receiveMessage (server.go:995). Wraps the
+single-node API with:
+
+- schema ops broadcast to peers (broadcast.go semantics);
+- client-facing queries routed through the ClusterExecutor;
+- /internal query serving for peers (remote-mode executor);
+- import routing: bits grouped by shard, forwarded to every replica of
+  the owning partition (api.go:1438 Import with remote flag);
+- shard-availability gossip so every node knows the cluster-wide shard
+  set (the reference keeps these bitmaps in etcd via Sharder,
+  etcd/embed.go Sharder);
+- cluster-state gating: writes need NORMAL, reads work in DEGRADED,
+  everything is refused when DOWN (disco/disco.go:53-61).
+
+Exposes the same surface the HTTP handler and SQL engine use on the
+plain API, so both layers work unchanged against a node.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster import broadcast as B
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.disco import DisCo, SingleNodeDisCo
+from pilosa_tpu.cluster.executor import ClusterExecutor
+from pilosa_tpu.cluster.topology import (
+    ClusterSnapshot, Node, STATE_DOWN, STATE_NORMAL,
+)
+from pilosa_tpu.errors import ClusterStateError
+from pilosa_tpu.pql.executor import Executor, _WRITE_CALLS
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql.result import result_to_json, result_to_wire
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+MSG_AVAILABLE_SHARDS = "available-shards"
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, uri: str = "",
+                 disco: Optional[DisCo] = None, path: Optional[str] = None,
+                 replica_n: int = 1, client: Optional[InternalClient] = None):
+        self.api = API(path)
+        self.node = Node(id=node_id, uri=uri)
+        self.disco = disco or SingleNodeDisCo(self.node)
+        if hasattr(self.disco, "register"):
+            self.disco.register(self.node)
+        self.replica_n = replica_n
+        self.client = client or InternalClient()
+        self.broadcaster = B.HTTPBroadcaster(
+            self.client, self.disco.nodes, node_id)
+        self._remote_exec = Executor(self.api.holder, remote=True)
+        self._sql_engine = None  # lazily built by API.sql (shared impl)
+        self._remote_shards: Dict[str, Set[int]] = {}
+        self._announced: Dict[str, Set[int]] = {}
+        self._lock = threading.Lock()
+        self.executor = ClusterExecutor(
+            node_id, self.api.holder, self.client, self.snapshot,
+            self.all_shards, on_node_down=self._mark_down,
+            live_fn=lambda: set(self.disco.live_ids()))
+        self.executor._after_write = self._announce_shards_all
+
+    # -- topology ----------------------------------------------------------
+
+    def snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot(self.disco.nodes(), replica_n=self.replica_n)
+
+    def state(self) -> str:
+        return self.snapshot().cluster_state(self.disco.live_ids())
+
+    def _mark_down(self, node_id: str) -> None:
+        for meth in ("down", "mark_down"):
+            fn = getattr(self.disco, meth, None)
+            if fn is not None:
+                fn(node_id)
+                return
+
+    def _check_state(self, write: bool) -> None:
+        state = self.state()
+        if state == STATE_DOWN:
+            raise ClusterStateError(f"cluster is {state}; not serving")
+        if write and state != STATE_NORMAL:
+            raise ClusterStateError(
+                f"cluster is {state}; writes require NORMAL")
+
+    # -- shard registry ----------------------------------------------------
+
+    def all_shards(self, index: str) -> Set[int]:
+        local: Set[int] = set()
+        idx = self.api.holder.indexes.get(index)
+        if idx is not None:
+            local = idx.shards()
+        with self._lock:
+            return local | self._remote_shards.get(index, set())
+
+    def _announce_shards_all(self, idx=None) -> None:
+        for name in list(self.api.holder.indexes):
+            self._announce_shards(name)
+
+    def _announce_shards(self, index: str) -> None:
+        idx = self.api.holder.indexes.get(index)
+        if idx is None:
+            return
+        shards = idx.shards()
+        with self._lock:
+            if shards <= self._announced.get(index, set()):
+                return
+            self._announced[index] = set(shards)
+        self.broadcaster.send_async({
+            "type": MSG_AVAILABLE_SHARDS, "index": index,
+            "shards": sorted(shards), "node": self.node.id,
+        })
+
+    # -- schema ops (broadcast to peers; reference: api.go CreateIndex) ----
+
+    def create_index(self, name: str, options: Optional[dict] = None):
+        self._check_state(write=True)
+        idx = self.api.create_index(name, options)
+        self.broadcaster.send_sync(
+            {"type": B.MSG_CREATE_INDEX, "index": name, "options": options})
+        return idx
+
+    def delete_index(self, name: str, broadcast: bool = True) -> None:
+        self.api.delete_index(name)
+        with self._lock:
+            self._remote_shards.pop(name, None)
+            self._announced.pop(name, None)
+        if broadcast:
+            self.broadcaster.send_sync(
+                {"type": B.MSG_DELETE_INDEX, "index": name})
+
+    def create_field(self, index: str, field: str,
+                     options: Optional[dict] = None):
+        self._check_state(write=True)
+        f = self.api.create_field(index, field, options)
+        self.broadcaster.send_sync({"type": B.MSG_CREATE_FIELD, "index": index,
+                                    "field": field, "options": options})
+        return f
+
+    def delete_field(self, index: str, field: str,
+                     broadcast: bool = True) -> None:
+        self.api.delete_field(index, field)
+        if broadcast:
+            self.broadcaster.send_sync({"type": B.MSG_DELETE_FIELD,
+                                        "index": index, "field": field})
+
+    def ensure_index(self, name: str, options: Optional[dict] = None):
+        if name not in self.api.holder.indexes:
+            self.api.create_index(name, options)
+
+    def ensure_field(self, index: str, field: str,
+                     options: Optional[dict] = None):
+        idx = self.api.holder.indexes.get(index)
+        if idx is not None and field not in idx.fields:
+            self.api.create_field(index, field, options)
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, index: str, pql: str,
+              shards: Optional[Sequence[int]] = None) -> List[Any]:
+        q = parse(pql)
+        self._check_state(write=any(
+            c.name in _WRITE_CALLS for c in q.calls))
+        return self.executor.execute(index, q, shards=shards)
+
+    def query_json(self, index: str, pql: str) -> dict:
+        return {"results": [result_to_json(r)
+                            for r in self.query(index, pql)]}
+
+    def query_remote(self, index: str, pql: str,
+                     shards: Sequence[int]) -> List[dict]:
+        """Serve a peer's sub-query (reference: the Remote:true branch of
+        handlePostQuery): local shards only, raw IDs, no truncation."""
+        results = self._remote_exec.execute(index, parse(pql), shards=shards)
+        self._announce_shards(index)
+        return [result_to_wire(r) for r in results]
+
+    # The SQL engine plans against this node's surface, so PQL pushdowns
+    # ride the cluster executor (self.executor). Same lazy-init as the
+    # single-node path — share the one implementation.
+    sql = API.sql
+
+    # -- imports (reference: api.go:1438 Import / :618 ImportRoaring) ------
+
+    def import_bits(self, index: str, field: str, rows=None, cols=None,
+                    row_keys=None, col_keys=None, clear: bool = False,
+                    remote: bool = False) -> int:
+        if remote:
+            n = self.api.import_bits(index, field, rows=rows, cols=cols,
+                                     clear=clear)
+            self._announce_shards(index)
+            return n
+        self._check_state(write=True)
+        tr = self.executor.translator
+        if col_keys:
+            ids = tr.index_keys(index, list(col_keys), create=True)
+            cols = [ids[k] for k in col_keys]
+        if row_keys:
+            ids = tr.field_keys(index, field, list(row_keys), create=True)
+            rows = [ids[k] for k in row_keys]
+        total = 0
+        for node, shard_rows, shard_cols, primary in self._route_bits(
+                index, rows, cols):
+            payload = {"field": field, "rows": shard_rows,
+                       "cols": shard_cols, "clear": clear, "remote": True}
+            if node.id == self.node.id:
+                n = self.api.import_bits(index, field, rows=shard_rows,
+                                         cols=shard_cols, clear=clear)
+            else:
+                n = self.client.import_bits(node, index, field,
+                                            payload).get("changed", 0)
+            if primary:
+                total += n
+        self._announce_shards(index)
+        return total
+
+    def import_values(self, index: str, field: str, cols=None, values=None,
+                      col_keys=None, remote: bool = False) -> int:
+        if remote:
+            n = self.api.import_values(index, field, cols=cols, values=values)
+            self._announce_shards(index)
+            return n
+        self._check_state(write=True)
+        tr = self.executor.translator
+        if col_keys:
+            ids = tr.index_keys(index, list(col_keys), create=True)
+            cols = [ids[k] for k in col_keys]
+        total = 0
+        for node, shard_vals, shard_cols, primary in self._route_bits(
+                index, values, cols):
+            payload = {"field": field, "cols": shard_cols,
+                       "values": shard_vals, "remote": True}
+            if node.id == self.node.id:
+                n = self.api.import_values(index, field, cols=shard_cols,
+                                           values=shard_vals)
+            else:
+                n = self.client.import_values(node, index, field,
+                                              payload).get("imported", 0)
+            if primary:
+                total += n
+        self._announce_shards(index)
+        return total
+
+    def _route_bits(self, index: str, rows, cols):
+        """Yield (node, rows-chunk, cols-chunk, is_primary) for every
+        replica of every shard touched (reference: internal_client.go:750
+        import fan-out by shard)."""
+        snap = self.snapshot()
+        by_shard: Dict[int, List[int]] = {}
+        for i, c in enumerate(cols):
+            by_shard.setdefault(int(c) // SHARD_WIDTH, []).append(i)
+        plan: Dict[str, Dict[str, Any]] = {}
+        for shard, idxs in by_shard.items():
+            owners = snap.shard_nodes(index, shard)
+            for rank, node in enumerate(owners):
+                ent = plan.setdefault(node.id + f"#{rank == 0}", {
+                    "node": node, "rows": [], "cols": [],
+                    "primary": rank == 0})
+                ent["rows"].extend(rows[i] for i in idxs)
+                ent["cols"].extend(cols[i] for i in idxs)
+        for ent in plan.values():
+            yield ent["node"], ent["rows"], ent["cols"], ent["primary"]
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: Dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
+        if remote:
+            self.api.import_roaring(index, field, shard, views, clear=clear)
+            self._announce_shards(index)
+            return
+        self._check_state(write=True)
+        import base64
+
+        snap = self.snapshot()
+        payload = {"field": field, "clear": clear, "remote": True,
+                   "views": {v: base64.b64encode(b).decode()
+                             for v, b in views.items()}}
+        for node in snap.shard_nodes(index, shard):
+            if node.id == self.node.id:
+                self.api.import_roaring(index, field, shard, views,
+                                        clear=clear)
+            else:
+                self.client.import_roaring_shard(node, index, shard, payload)
+        self._announce_shards(index)
+
+    # -- broadcast receive (reference: server.go:995 receiveMessage) -------
+
+    def receive_message(self, msg: dict) -> None:
+        t = msg.get("type")
+        if t == MSG_AVAILABLE_SHARDS:
+            with self._lock:
+                self._remote_shards.setdefault(
+                    msg["index"], set()).update(msg["shards"])
+            return
+        B.apply_message(self, msg)
+
+    # -- passthroughs so HTTP/SQL layers see one surface -------------------
+
+    @property
+    def holder(self):
+        return self.api.holder
+
+    def schema(self) -> List[dict]:
+        return self.api.schema()
+
+    def save(self) -> None:
+        self.api.save()
+
+    def info(self) -> dict:
+        d = self.api.info()
+        d["node"] = self.node.to_json()
+        d["state"] = self.state()
+        d["replicaN"] = self.replica_n
+        return d
+
+    def status(self) -> dict:
+        return {"state": self.state(),
+                "nodes": [n.to_json() for n in self.disco.nodes()],
+                "localID": self.node.id,
+                "indexes": sorted(self.api.holder.indexes)}

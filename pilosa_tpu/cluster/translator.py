@@ -1,0 +1,96 @@
+"""ClusterTranslator: routes key<->ID traffic to the owning nodes.
+
+Reference: cluster.go:233-887 — the coordinator batches keys per
+key-partition, RPCs each batch to the partition primary, and retries
+on ownership races. Row (field) keys all live on one stable node, the
+partition-0 primary (disco/snapshot.go:137). Locally-owned partitions
+hit the holder's stores directly, so a single-node cluster never pays
+an RPC.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class ClusterTranslator:
+    def __init__(self, node_id: str, holder, client, snapshot_fn):
+        self.node_id = node_id
+        self.holder = holder
+        self.client = client
+        self._snapshot_fn = snapshot_fn  # () -> ClusterSnapshot
+
+    # -- index (record) keys ----------------------------------------------
+
+    def _group_keys_by_node(self, snap, index: str, keys: Iterable[str]):
+        by_node: Dict[str, List[str]] = {}
+        nodes = {}
+        for k in keys:
+            owner = snap.key_nodes(index, k)[0]
+            nodes[owner.id] = owner
+            by_node.setdefault(owner.id, []).append(k)
+        return by_node, nodes
+
+    def index_keys(self, index: str, keys: List[str],
+                   create: bool) -> Dict[str, int]:
+        snap = self._snapshot_fn()
+        by_node, nodes = self._group_keys_by_node(snap, index, keys)
+        out: Dict[str, int] = {}
+        for node_id, batch in by_node.items():
+            if node_id == self.node_id:
+                store = self.holder.index(index).translate
+                out.update(store.create_keys(batch) if create
+                           else store.find_keys(batch))
+            elif create:
+                out.update(self.client.create_index_keys(
+                    nodes[node_id], index, batch))
+            else:
+                out.update(self.client.find_index_keys(
+                    nodes[node_id], index, batch))
+        return out
+
+    def index_ids(self, index: str, ids: Iterable[int]) -> Dict[int, str]:
+        """ID->key: an ID's shard hashes to the partition that owns the
+        key (translate.go:103 invariant), so route by shard."""
+        snap = self._snapshot_fn()
+        by_node: Dict[str, List[int]] = {}
+        nodes = {}
+        for i in ids:
+            p = snap.shard_to_partition(index, i // SHARD_WIDTH)
+            owner = snap.partition_nodes(p)[0]
+            nodes[owner.id] = owner
+            by_node.setdefault(owner.id, []).append(i)
+        out: Dict[int, str] = {}
+        for node_id, batch in by_node.items():
+            if node_id == self.node_id:
+                out.update(self.holder.index(index).translate.translate_ids(batch))
+            else:
+                out.update(self.client.translate_index_ids(
+                    nodes[node_id], index, batch))
+        return out
+
+    # -- field (row) keys --------------------------------------------------
+
+    def _field_primary(self):
+        return self._snapshot_fn().primary_field_translation_node()
+
+    def field_keys(self, index: str, field: str, keys: List[str],
+                   create: bool) -> Dict[str, int]:
+        primary = self._field_primary()
+        if primary is None or primary.id == self.node_id:
+            store = self.holder.index(index).field(field).translate
+            return (store.create_keys(keys) if create
+                    else store.find_keys(keys))
+        if create:
+            return self.client.create_field_keys(primary, index, field, keys)
+        return self.client.find_field_keys(primary, index, field, keys)
+
+    def field_ids(self, index: str, field: str,
+                  ids: Iterable[int]) -> Dict[int, str]:
+        primary = self._field_primary()
+        ids = list(ids)
+        if primary is None or primary.id == self.node_id:
+            return self.holder.index(index).field(field).translate.translate_ids(ids)
+        return self.client.translate_field_ids(primary, index, field, ids)

@@ -13,7 +13,7 @@ from typing import Dict, List, Optional, Set
 
 from pilosa_tpu.core.field import Field
 from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
-from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.core.translate import PartitionedTranslateStore
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 EXISTENCE_FIELD = "_exists"
@@ -29,8 +29,10 @@ class Index:
         self.options = options or IndexOptions()
         self.path = path
         self.fields: Dict[str, Field] = {}
+        # Record keys are partition-hashed so key ownership == shard
+        # ownership across a cluster (reference: translate.go:103).
         self.translate = (
-            TranslateStore(self._translate_path(), start=0)
+            PartitionedTranslateStore(name, self._translate_path())
             if self.options.keys else None
         )
         if self.options.track_existence:

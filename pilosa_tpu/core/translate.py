@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, Iterable, List, Optional
 
 
@@ -29,6 +30,7 @@ class TranslateStore:
         self._path = path
         self._start = start
         self._next = start
+        self._lock = threading.Lock()  # create RPCs arrive concurrently
         self.key_to_id: Dict[str, int] = {}
         self.id_to_key: Dict[int, str] = {}
         if path and os.path.exists(path):
@@ -57,17 +59,115 @@ class TranslateStore:
         batched, find-first then allocate misses)."""
         out: Dict[str, int] = {}
         new: List = []
-        for k in keys:
-            id_ = self.key_to_id.get(k)
-            if id_ is None:
-                id_ = self._next
-                self._next += 1
-                self.key_to_id[k] = id_
-                self.id_to_key[id_] = k
-                new.append((k, id_))
-            out[k] = id_
-        if new:
-            self._append(new)
+        with self._lock:
+            for k in keys:
+                id_ = self.key_to_id.get(k)
+                if id_ is None:
+                    id_ = self._next
+                    self._next += 1
+                    self.key_to_id[k] = id_
+                    self.id_to_key[id_] = k
+                    new.append((k, id_))
+                out[k] = id_
+            if new:
+                self._append(new)
+        return out
+
+    def find_keys(self, keys: Iterable[str]) -> Dict[str, int]:
+        return {k: self.key_to_id[k] for k in keys if k in self.key_to_id}
+
+    def translate_ids(self, ids: Iterable[int]) -> Dict[int, str]:
+        return {i: self.id_to_key[i] for i in ids if i in self.id_to_key}
+
+    def __len__(self) -> int:
+        return len(self.key_to_id)
+
+
+class PartitionedTranslateStore:
+    """Record-key store partitioned the way the reference partitions its
+    BoltDB stores (translate_boltdb.go:69 + disco/snapshot.go:87): a key
+    belongs to partition fnv64a(index||key)%N, and the ID allocated for it
+    is chosen so the ID's *shard* hashes back to the same partition
+    (reference: translate.go:103 GenerateNextPartitionedID). Shard
+    ownership and key ownership therefore coincide — the column a key
+    names lives on the node that owns the key.
+
+    Same journal format as TranslateStore; partition state is
+    reconstructed from key hashes on load.
+    """
+
+    def __init__(self, index: str, path: Optional[str] = None,
+                 partition_n: int = 256):
+        from pilosa_tpu.hashing import key_to_partition, shard_to_partition
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        self._index = index
+        self._path = path
+        self._partition_n = partition_n
+        self._key_to_partition = key_to_partition
+        self._shard_to_partition = shard_to_partition
+        self._shard_width = SHARD_WIDTH
+        self._lock = threading.Lock()
+        self.key_to_id: Dict[str, int] = {}
+        self.id_to_key: Dict[int, str] = {}
+        self._max_id: Dict[int, int] = {}  # partition -> max allocated id
+        if path and os.path.exists(path):
+            self._load()
+
+    def _load(self):
+        with open(self._path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                key, id_ = json.loads(line)
+                self.key_to_id[key] = id_
+                self.id_to_key[id_] = key
+                p = self.partition(key)
+                self._max_id[p] = max(self._max_id.get(p, 0), id_)
+
+    def partition(self, key: str) -> int:
+        return self._key_to_partition(self._index, key, self._partition_n)
+
+    def _next_partitioned_id(self, partition: int) -> int:
+        """Reference: translate.go:111 — walk forward by shard until the
+        shard's partition matches; IDs start at 1 (0 stays invalid). Also
+        skips IDs already present, so journals written under other
+        allocation schemes can't cause silent ID reuse."""
+        id_ = self._max_id.get(partition, 0) + 1
+        while True:
+            if self._shard_to_partition(
+                    self._index, id_ // self._shard_width,
+                    self._partition_n) != partition:
+                id_ += self._shard_width
+            elif id_ in self.id_to_key:
+                id_ += 1
+            else:
+                return id_
+
+    def _append(self, pairs: List):
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(self._path, "a") as f:
+            for key, id_ in pairs:
+                f.write(json.dumps([key, id_]) + "\n")
+
+    def create_keys(self, keys: Iterable[str]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        new: List = []
+        with self._lock:
+            for k in keys:
+                id_ = self.key_to_id.get(k)
+                if id_ is None:
+                    p = self.partition(k)
+                    id_ = self._next_partitioned_id(p)
+                    self._max_id[p] = id_
+                    self.key_to_id[k] = id_
+                    self.id_to_key[id_] = k
+                    new.append((k, id_))
+                out[k] = id_
+            if new:
+                self._append(new)
         return out
 
     def find_keys(self, keys: Iterable[str]) -> Dict[str, int]:

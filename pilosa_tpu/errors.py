@@ -1,0 +1,10 @@
+"""Shared error types (reference: errors/ wrapped error codes).
+
+Defined here, away from both the HTTP and cluster layers, so either can
+import them without cycles.
+"""
+
+
+class ClusterStateError(RuntimeError):
+    """Operation not allowed in the current cluster state (reference:
+    api.go:160-187 validAPIMethods gating)."""

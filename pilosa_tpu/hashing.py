@@ -1,0 +1,55 @@
+"""Placement hashing: fnv64a partitioning + jump consistent hash.
+
+Reference: disco/snapshot.go:69 ShardToShardPartition (fnv64a over
+index-name bytes then big-endian shard), :87 KeyToKeyPartition, and
+disco/hasher.go:13 Jmphasher (Lamping-Veach jump consistent hash).
+Byte-for-byte the same hash inputs so a cluster of this engine and the
+reference agree on shard->partition mapping.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_FNV_OFFSET = 14695981039346656037
+_FNV_PRIME = 1099511628211
+_MASK64 = (1 << 64) - 1
+
+DEFAULT_PARTITION_N = 256
+
+
+def fnv64a(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash: key -> bucket in [0, n).
+
+    Reference: disco/hasher.go:16 (Jmphasher.Hash). The float math matches
+    the Go implementation (both use 64-bit doubles).
+    """
+    if n <= 0:
+        return -1
+    b, j = -1, 0
+    key &= _MASK64
+    while j < n:
+        b = j
+        key = (key * 2862933555777941757 + 1) & _MASK64
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+def shard_to_partition(index: str, shard: int,
+                       partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """Reference: disco/snapshot.go:70 (fnv64a(index || be64(shard)) % N)."""
+    return fnv64a(index.encode() + struct.pack(">Q", shard)) % partition_n
+
+
+def key_to_partition(index: str, key: str,
+                     partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """Reference: disco/snapshot.go:88 (fnv64a(index || key) % N)."""
+    return fnv64a(index.encode() + key.encode()) % partition_n

@@ -56,7 +56,12 @@ class IDAllocator:
                     self._sessions[rec["session"]] = (rec["offset"], rng)
                     self._next = max(self._next, rng.end)
                 elif rec["op"] == "commit":
-                    self._sessions.pop(rec["session"], None)
+                    prev = self._sessions.pop(rec["session"], None)
+                    used = rec.get("used")
+                    if prev is not None and used is not None:
+                        _, rng = prev
+                        if rng.end == self._next:
+                            self._next = rng.base + used
 
     def _journal(self, rec: dict):
         if not self._path:
@@ -95,10 +100,13 @@ class IDAllocator:
             if prev is None:
                 return
             _, rng = prev
+            used = None
             if count is not None and 0 <= count < rng.count and \
                     rng.end == self._next:
                 self._next = rng.base + count
-            self._journal({"op": "commit", "session": session})
+                used = count
+            # `used` makes the tail-ID rollback replayable on reload.
+            self._journal({"op": "commit", "session": session, "used": used})
 
     def reset(self, session: str) -> None:
         """Abandon a session without committing."""

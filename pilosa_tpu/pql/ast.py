@@ -25,6 +25,27 @@ class Condition:
         if self.op not in OPS:
             raise ValueError(f"bad condition op {self.op!r}")
 
+    def to_pql(self, field: str) -> str:
+        if self.op == "between":
+            lo, hi = self.value
+            return f"{_pql_value(lo)} <= {field} <= {_pql_value(hi)}"
+        return f"{field} {self.op} {_pql_value(self.value)}"
+
+
+def _pql_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, str):
+        body = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{body}"'
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_pql_value(x) for x in v) + "]"
+    if isinstance(v, Call):
+        return v.to_pql()
+    return str(v)
+
 
 @dataclasses.dataclass
 class Call:
@@ -51,6 +72,28 @@ class Call:
         parts += [f"{k}={v!r}" for k, v in self.args.items()]
         return f"{self.name}({', '.join(parts)})"
 
+    def to_pql(self) -> str:
+        """Serialize back to PQL text (round-trips through the parser).
+        Used to forward calls to peer nodes (the reference ships the
+        pre-translated call tree in its remote query payload,
+        executor.go:6392 remoteExec)."""
+        parts: List[str] = []
+        if "_col" in self.args:
+            parts.append(_pql_value(self.args["_col"]))
+        elif "_field" in self.args:
+            parts.append(str(self.args["_field"]))
+        parts += [c.to_pql() for c in self.children]
+        for k, v in self.args.items():
+            if k in ("_col", "_field", "_timestamp"):
+                continue
+            if isinstance(v, Condition):
+                parts.append(v.to_pql(k))
+            else:
+                parts.append(f"{k}={_pql_value(v)}")
+        if "_timestamp" in self.args:
+            parts.append(str(self.args["_timestamp"]))
+        return f"{self.name}({', '.join(parts)})"
+
 
 # Option-arg names per call, for field_arg() exclusion (reference: the
 # per-call arg handling in executor.go's execute* functions).
@@ -63,3 +106,6 @@ class Query:
 
     def __repr__(self):
         return "".join(repr(c) for c in self.calls)
+
+    def to_pql(self) -> str:
+        return "".join(c.to_pql() for c in self.calls)

@@ -56,10 +56,18 @@ def _parse_ts(v) -> dt.datetime:
 
 
 class Executor:
-    """Reference: executor.go:55 (executor struct)."""
+    """Reference: executor.go:55 (executor struct).
 
-    def __init__(self, holder: Holder):
+    ``remote=True`` puts the executor in peer-serving mode (the analog of
+    the reference's Remote:true query flag, executor.go:6392 remoteExec):
+    results keep raw IDs (no key translation — that happens once at the
+    coordinator, executor.go:7519) and rankings/limits are NOT truncated,
+    so the coordinator's monoid merge stays exact.
+    """
+
+    def __init__(self, holder: Holder, remote: bool = False):
         self.holder = holder
+        self.remote = remote
         self._zeros: Dict[int, jnp.ndarray] = {}
 
     # -- public entry (reference: executor.go:183 Execute) --------------------
@@ -82,7 +90,7 @@ class Executor:
                 shards = [int(s) for s in call.arg("shards")]
             return self._execute_call(idx, call.children[0], shards)
         if name in _WRITE_CALLS:
-            return self._execute_write(idx, call)
+            return self._execute_write(idx, call, shards)
         if name == "Count":
             return self._execute_count(idx, call, shards)
         if name in ("Sum", "Min", "Max"):
@@ -279,6 +287,8 @@ class Executor:
             limit = call.arg("limit")
             offset = int(call.arg("offset", 0))
             call = call.children[0]
+            if self.remote:  # coordinator applies limit/offset after merge
+                limit, offset = None, 0
         if call.name == "Distinct":
             return self._execute_distinct(idx, call, shards)
         cols: List[int] = []
@@ -293,7 +303,7 @@ class Executor:
         return self._row_result(idx, cols)
 
     def _row_result(self, idx: Index, cols: List[int]) -> R.RowResult:
-        if idx.options.keys:
+        if idx.options.keys and not self.remote:
             m = idx.translate.translate_ids(cols)
             return R.RowResult(columns=[], keys=[m.get(c, str(c)) for c in cols])
         return R.RowResult(columns=cols)
@@ -390,13 +400,13 @@ class Executor:
                 if c:
                     counts[row] = counts.get(row, 0) + c
         ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
-        if n is not None:
+        if n is not None and not self.remote:
             ranked = ranked[: int(n)]
         return self._pairs_field(field, ranked)
 
     def _pairs_field(self, field: Field, ranked: List[Tuple[int, int]]
                      ) -> R.PairsField:
-        if field.options.keys:
+        if field.options.keys and not self.remote:
             keys = field.translate.translate_ids([r for r, _ in ranked])
             pairs = [R.Pair(id=None, key=keys.get(r, str(r)), count=c)
                      for r, c in ranked]
@@ -440,14 +450,14 @@ class Executor:
             prev_id = self._row_id(field, prev)
             out = [r for r in out if prev_id is None or r > prev_id]
         limit = call.arg("limit")
-        if limit is not None:
+        if limit is not None and not self.remote:
             out = out[: int(limit)]
         return out
 
     def _execute_rows(self, idx: Index, call: Call, shards) -> List[Any]:
         field = idx.field(self._field_name(call))
         rows = self._rows_list(idx, call, shards)
-        if field.options.keys:
+        if field.options.keys and not self.remote:
             m = field.translate.translate_ids(rows)
             return [m.get(r, str(r)) for r in rows]
         return rows
@@ -459,7 +469,7 @@ class Executor:
         if not field.options.type.is_bsi:
             # Set-like: distinct values are the row IDs present.
             rows = self._rows_list(idx, call, shards)
-            if field.options.keys:
+            if field.options.keys and not self.remote:
                 m = field.translate.translate_ids(rows)
                 return R.RowResult(columns=[], keys=[m.get(r, str(r)) for r in rows])
             return R.RowResult(columns=rows)
@@ -528,12 +538,12 @@ class Executor:
                 group=group, count=count,
                 agg=agg if agg_field is not None else None))
         limit = call.arg("limit")
-        if limit is not None:
+        if limit is not None and not self.remote:
             out = out[: int(limit)]
         return out
 
     def _field_row(self, field: Field, row: int) -> R.FieldRow:
-        if field.options.keys:
+        if field.options.keys and not self.remote:
             key = field.translate.translate_ids([row]).get(row, str(row))
             return R.FieldRow(field=field.name, row_key=key)
         return R.FieldRow(field=field.name, row_id=row)
@@ -720,7 +730,7 @@ class Executor:
                             hit = ((rp[w] >> b) & 1).astype(bool)
                             for i in np.nonzero(hit)[0]:
                                 rows_per_col[i].append(row)
-                        if f.options.keys:
+                        if f.options.keys and not self.remote:
                             all_rows = {r for rs in rows_per_col for r in rs}
                             m = f.translate.translate_ids(all_rows)
                             rows_per_col = [[m.get(r, str(r)) for r in rs]
@@ -730,7 +740,7 @@ class Executor:
                                             for rs in rows_per_col]
                     per_field_vals.append(rows_per_col)
             key_map = {}
-            if idx.options.keys:
+            if idx.options.keys and not self.remote:
                 key_map = idx.translate.translate_ids(
                     [int(base + c) for c in local])
             for i, c in enumerate(local):
@@ -744,21 +754,21 @@ class Executor:
 
     # -- writes (reference: executor.go executeSet/Clear/Store) ----------------
 
-    def _execute_write(self, idx: Index, call: Call) -> bool:
+    def _execute_write(self, idx: Index, call: Call, shards=None) -> bool:
         name = call.name
         if name == "Set":
             return self._execute_set(idx, call)
         if name == "Clear":
             return self._execute_clear(idx, call)
         if name == "ClearRow":
-            return self._execute_clear_row(idx, call)
+            return self._execute_clear_row(idx, call, shards)
         if name == "Store":
-            return self._execute_store(idx, call)
+            return self._execute_store(idx, call, shards)
         if name == "Delete":
-            return self._execute_delete(idx, call)
+            return self._execute_delete(idx, call, shards)
         raise PQLError(f"write call {name!r} not implemented")
 
-    def _execute_delete(self, idx: Index, call: Call) -> int:
+    def _execute_delete(self, idx: Index, call: Call, shards=None) -> int:
         """Delete the records selected by the child bitmap: clear their
         columns from every fragment of every field, the existence field,
         and all BSI planes (reference: executor.go:9050
@@ -766,7 +776,7 @@ class Executor:
         if not call.children:
             raise PQLError("Delete requires a bitmap child")
         deleted = 0
-        for shard in self._shards(idx, None):
+        for shard in self._shards(idx, shards):
             plane = np.asarray(self._eval(idx, call.children[0], shard))
             if idx.existence is not None:
                 # count only records that actually exist (reference:
@@ -823,7 +833,7 @@ class Executor:
             return False
         return field.clear_bit(row, col)
 
-    def _execute_clear_row(self, idx: Index, call: Call) -> bool:
+    def _execute_clear_row(self, idx: Index, call: Call, shards=None) -> bool:
         fa = call.field_arg()
         if fa is None:
             raise PQLError("ClearRow requires field=row")
@@ -833,7 +843,9 @@ class Executor:
         if row is None:
             return False
         changed = False
-        for shard in sorted(field.shards()):
+        shard_list = (sorted(field.shards()) if shards is None
+                      else sorted(set(shards) & field.shards()))
+        for shard in shard_list:
             for view in list(field.views):
                 frag = field.fragment(shard, view)
                 if frag is not None and frag.has_row(row):
@@ -842,7 +854,7 @@ class Executor:
                     changed = True
         return changed
 
-    def _execute_store(self, idx: Index, call: Call) -> bool:
+    def _execute_store(self, idx: Index, call: Call, shards=None) -> bool:
         """Store(bitmap, field=row): write the result as a row (reference:
         executor.go executeSetRow)."""
         fa = call.field_arg()
@@ -853,7 +865,7 @@ class Executor:
         if field.options.type.is_bsi:
             raise PQLError("Store targets a set field row")
         row = self._row_id(field, value, create=True)
-        for shard in self._shards(idx, None):
+        for shard in self._shards(idx, shards):
             plane = np.asarray(self._eval(idx, call.children[0], shard))
             frag = field.fragment(shard, create=True)
             frag.import_row_plane(row, plane, clear=True)

@@ -29,8 +29,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from pilosa_tpu.api import API
+from pilosa_tpu.errors import ClusterStateError
 
 _ROUTES = [
+    # node-to-node endpoints (reference: http_handler.go:552-585 /internal/*)
+    ("POST", re.compile(r"^/internal/index/([^/]+)/query$"),
+     "post_internal_query"),
+    ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    ("POST", re.compile(r"^/internal/translate/index/([^/]+)/keys/(create|find)$"),
+     "post_translate_index_keys"),
+    ("POST", re.compile(r"^/internal/translate/index/([^/]+)/ids$"),
+     "post_translate_index_ids"),
+    ("POST", re.compile(
+        r"^/internal/translate/field/([^/]+)/([^/]+)/keys/(create|find)$"),
+     "post_translate_field_keys"),
+    ("POST", re.compile(r"^/internal/translate/field/([^/]+)/([^/]+)/ids$"),
+     "post_translate_field_ids"),
     ("POST", re.compile(r"^/index/([^/]+)/query$"), "post_query"),
     ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "post_field"),
     ("DELETE", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "delete_field"),
@@ -96,6 +110,9 @@ class Handler(BaseHTTPRequestHandler):
                     self._send(404, {"error": str(e)})
                 except (ValueError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
+                except ClusterStateError as e:
+                    # gated by cluster state (reference: api.go:160)
+                    self._send(412, {"error": str(e)})
                 except Exception as e:  # pragma: no cover - last resort
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
@@ -152,6 +169,7 @@ class Handler(BaseHTTPRequestHandler):
             rows=b.get("rows", []), cols=b.get("cols", []),
             row_keys=b.get("rowKeys"), col_keys=b.get("colKeys"),
             clear=bool(b.get("clear", False)),
+            remote=bool(b.get("remote", False)),
         )
         self._send(200, {"changed": n})
 
@@ -166,7 +184,8 @@ class Handler(BaseHTTPRequestHandler):
         views = {v: base64.b64decode(blob)
                  for v, blob in (b.get("views") or {}).items()}
         self.api.import_roaring(index, self._require(b, "field"), int(shard), views,
-                                clear=bool(b.get("clear", False)))
+                                clear=bool(b.get("clear", False)),
+                                remote=bool(b.get("remote", False)))
         self._send(200, {"success": True})
 
     def post_import_values(self, index: str):
@@ -174,6 +193,7 @@ class Handler(BaseHTTPRequestHandler):
         n = self.api.import_values(
             index, self._require(b, "field"), cols=b.get("cols", []),
             values=b.get("values", []), col_keys=b.get("colKeys"),
+            remote=bool(b.get("remote", False)),
         )
         self._send(200, {"imported": n})
 
@@ -181,8 +201,62 @@ class Handler(BaseHTTPRequestHandler):
         self._send(200, {"indexes": self.api.schema()})
 
     def get_status(self):
+        status_fn = getattr(self.api, "status", None)
+        if status_fn is not None:
+            self._send(200, status_fn())
+            return
         self._send(200, {"state": "NORMAL", "indexes": sorted(
             self.api.holder.indexes)})
+
+    # -- internal (node-to-node) handlers ---------------------------------
+
+    def _node_only(self):
+        """Internal endpoints exist only on cluster nodes (the plain API
+        has no peers)."""
+        if not hasattr(self.api, "query_remote"):
+            raise KeyError("not a cluster node")
+
+    def post_internal_query(self, index: str):
+        self._node_only()
+        b = self._json_body()
+        results = self.api.query_remote(
+            index, self._require(b, "query"), b.get("shards") or [])
+        self._send(200, {"results": results})
+
+    def post_cluster_message(self):
+        self._node_only()
+        self.api.receive_message(self._json_body())
+        self._send(200, {"success": True})
+
+    def _translate_store(self, index: str, field: str = None):
+        idx = self.api.holder.index(index)
+        store = idx.translate if field is None else idx.field(field).translate
+        if store is None:
+            raise ValueError(f"no key translation on {index}/{field or ''}")
+        return store
+
+    def post_translate_index_keys(self, index: str, op: str):
+        keys = self._json_body().get("keys") or []
+        store = self._translate_store(index)
+        ids = (store.create_keys(keys) if op == "create"
+               else store.find_keys(keys))
+        self._send(200, {"ids": ids})
+
+    def post_translate_index_ids(self, index: str):
+        ids = self._json_body().get("ids") or []
+        self._send(200, {"keys": self._translate_store(index).translate_ids(ids)})
+
+    def post_translate_field_keys(self, index: str, field: str, op: str):
+        keys = self._json_body().get("keys") or []
+        store = self._translate_store(index, field)
+        ids = (store.create_keys(keys) if op == "create"
+               else store.find_keys(keys))
+        self._send(200, {"ids": ids})
+
+    def post_translate_field_ids(self, index: str, field: str):
+        ids = self._json_body().get("ids") or []
+        self._send(200, {"keys": self._translate_store(
+            index, field).translate_ids(ids)})
 
     def get_info(self):
         self._send(200, self.api.info())

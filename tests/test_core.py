@@ -228,12 +228,22 @@ class TestIndexHolder:
         assert bits(h2.index("i").existence_plane(0)) == {100}
 
     def test_translation(self, tmp_path):
+        from pilosa_tpu.hashing import key_to_partition, shard_to_partition
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
         h = Holder(str(tmp_path))
         idx = h.create_index("i", IndexOptions(keys=True))
         ids = idx.translate.create_keys(["alice", "bob"])
-        assert ids == {"alice": 0, "bob": 1}
+        assert set(ids) == {"alice", "bob"}
+        # Record-key IDs land in a shard whose partition matches the
+        # key's partition (reference: translate.go:103), and stay stable.
+        for k, id_ in ids.items():
+            assert id_ >= 1  # 0 stays invalid
+            assert (shard_to_partition("i", id_ // SHARD_WIDTH)
+                    == key_to_partition("i", k))
         again = idx.translate.create_keys(["bob", "carol"])
-        assert again == {"bob": 1, "carol": 2}
+        assert again["bob"] == ids["bob"]
+        assert len({*ids.values(), again["carol"]}) == 3  # all distinct
         # Row keys start at 1 (0 reserved).
         f = idx.create_field("f", FieldOptions(keys=True))
         rows = f.translate.create_keys(["x"])
@@ -241,5 +251,9 @@ class TestIndexHolder:
         # Journal replay.
         h2 = Holder(str(tmp_path))
         idx2 = h2.index("i")
-        assert idx2.translate.find_keys(["alice", "carol"]) == {"alice": 0, "carol": 2}
-        assert idx2.translate.translate_ids([1]) == {1: "bob"}
+        assert idx2.translate.find_keys(["alice", "carol"]) == {
+            "alice": ids["alice"], "carol": again["carol"]}
+        assert idx2.translate.translate_ids([ids["bob"]]) == {ids["bob"]: "bob"}
+        # Replayed stores keep allocating fresh IDs.
+        dave = idx2.translate.create_keys(["dave"])["dave"]
+        assert dave not in {ids["alice"], ids["bob"], again["carol"]}

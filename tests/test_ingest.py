@@ -90,6 +90,17 @@ def test_idalloc_commit_returns_tail():
     assert r2.base == r.base + 10
 
 
+def test_idalloc_commit_tail_survives_reload(tmp_path):
+    # The tail rollback must be journaled, not memory-only.
+    path = str(tmp_path / "ids.jsonl")
+    a = IDAllocator(path)
+    r = a.reserve("s", 1000)
+    a.commit("s", count=10)
+    b = IDAllocator(path)
+    assert b.next_id == a.next_id == r.base + 10
+    assert b.reserve("t", 5).base == r.base + 10
+
+
 def test_csv_source_typed_header(api, tmp_path):
     p = tmp_path / "data.csv"
     p.write_text(
