@@ -1,0 +1,190 @@
+"""Stacked device views: a field's fragments across shards as ONE tensor.
+
+The key TPU-latency insight: every PQL read kernel (popcount reductions,
+BSI compare circuits, pair-count matmuls) reduces over *columns* and never
+mixes columns, so concatenating the per-shard word axes
+
+    shard planes  uint32[R, W]  x S shards  ->  uint32[R, S*W]
+
+makes every single-shard kernel multi-shard with zero changes — one XLA
+dispatch and ONE host round-trip per query instead of one per shard. On a
+tunneled TPU a blocking fetch costs tens of milliseconds, so this is the
+difference between per-query latency scaling with shard count (the
+reference's per-shard map loop, executor.go:6742 mapperLocal) and staying
+flat.
+
+Row slots are the sorted union of row IDs across the stacked fragments so
+one slot index addresses the same row in every shard (the reference gets
+this for free from row-major roaring addressing, fragment.go:34-49).
+
+Caches are hung on the owning Field keyed by (view, shard tuple) and
+validated against the fragment version vector — a write to any member
+fragment invalidates (the coarse re-upload strategy documented in
+fragment.py; incremental device merge is a later optimization).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.ops import bsi as bsiops
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+_MIN_SLOTS = 8
+
+
+def _pow2(n: int) -> int:
+    cap = _MIN_SLOTS
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class StackedSet:
+    """Union-row view of set fragments: device uint32[Rcap, S*W]."""
+
+    def __init__(self, shards: Sequence[int], fragments, words: int = WORDS_PER_SHARD):
+        self.shards = tuple(shards)
+        self.words = words
+        self.total_words = len(self.shards) * words
+        rows: set = set()
+        for frag in fragments:
+            if frag is not None:
+                rows.update(frag.row_index)
+        self.row_ids: List[int] = sorted(rows)
+        self.row_index: Dict[int, int] = {r: i for i, r in enumerate(self.row_ids)}
+        cap = _pow2(len(self.row_ids))
+        host = np.zeros((cap, self.total_words), dtype=np.uint32)
+        for si, frag in enumerate(fragments):
+            if frag is None or not frag.row_ids:
+                continue
+            lo = si * words
+            for slot, row in enumerate(frag.row_ids):
+                host[self.row_index[row], lo:lo + words] = frag.planes[slot]
+        self.planes: jax.Array = jax.device_put(host)
+        self._zero: Optional[jax.Array] = None
+
+    def zero_plane(self) -> jax.Array:
+        if self._zero is None:
+            self._zero = jnp.zeros((self.total_words,), dtype=jnp.uint32)
+        return self._zero
+
+    def row_plane(self, row: int) -> jax.Array:
+        """Device [S*W] plane for one row id (zeros when absent)."""
+        slot = self.row_index.get(row)
+        if slot is None:
+            return self.zero_plane()
+        return self.planes[slot]
+
+    def rows_plane(self, rows: Sequence[int]) -> jax.Array:
+        """OR of several rows' planes (UnionRows)."""
+        slots = [self.row_index[r] for r in rows if r in self.row_index]
+        if not slots:
+            return self.zero_plane()
+        sel = self.planes[jnp.asarray(slots)]
+        return jax.lax.reduce(
+            sel, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0,))
+
+
+class StackedBSI:
+    """BSI plane stacks across shards: device uint32[2+depth, S*W].
+
+    Shards with shallower bit depth than the widest member are zero-padded
+    (a zero magnitude plane contributes nothing to compares or sums).
+    """
+
+    def __init__(self, shards: Sequence[int], fragments, words: int = WORDS_PER_SHARD):
+        self.shards = tuple(shards)
+        self.words = words
+        self.total_words = len(self.shards) * words
+        depth = max([f.depth for f in fragments if f is not None] or [1])
+        self.depth = depth
+        host = np.zeros((bsiops.OFFSET + depth, self.total_words), dtype=np.uint32)
+        for si, frag in enumerate(fragments):
+            if frag is None:
+                continue
+            lo = si * words
+            host[: frag.planes.shape[0], lo:lo + words] = frag.planes
+        self.planes: jax.Array = jax.device_put(host)
+
+    def exists_plane(self) -> jax.Array:
+        return self.planes[bsiops.EXISTS]
+
+
+def _versions(fragments) -> Tuple:
+    return tuple(-1 if f is None else f.version for f in fragments)
+
+
+# Cache layout: field._stacked_cache maps a *group* (kind, view) to an
+# inner OrderedDict of shard-subset -> (versions, stacked). Groups are
+# unbounded — each view's planes are distinct data, exactly as resident as
+# the per-fragment device caches they replace (a 30-view time-range query
+# keeps all 30 views warm). Within a group, each subset entry is a FULL
+# duplicate device copy of the member planes (e.g. Options(shards=[...])
+# stacks arbitrary subsets), so subsets are LRU-bounded to keep duplicates
+# from pinning HBM for the process lifetime.
+_MAX_SUBSETS_PER_GROUP = 4
+
+# The Executor is shared across server request threads (ThreadingHTTPServer)
+# and the cluster fan-out pool; OrderedDict move_to_end/popitem is not
+# atomic, so all cache bookkeeping runs under one lock. Builds (host concat
+# + device upload) happen outside it — a racing duplicate build is benign.
+_LOCK = threading.Lock()
+
+
+def _cache_get(field, group, subset, vers):
+    with _LOCK:
+        cache = getattr(field, "_stacked_cache", None)
+        if cache is None:
+            cache = field._stacked_cache = {}
+        inner = cache.get(group)
+        if inner is None:
+            return None
+        hit = inner.get(subset)
+        if hit is not None and hit[0] == vers:
+            inner.move_to_end(subset)
+            return hit[1]
+        return None
+
+
+def _cache_put(field, group, subset, vers, built):
+    with _LOCK:
+        cache = getattr(field, "_stacked_cache", None)
+        if cache is None:
+            cache = field._stacked_cache = {}
+        inner = cache.setdefault(group, OrderedDict())
+        inner[subset] = (vers, built)
+        inner.move_to_end(subset)
+        while len(inner) > _MAX_SUBSETS_PER_GROUP:
+            inner.popitem(last=False)
+
+
+def stacked_set(field, shards: Sequence[int], view: str) -> StackedSet:
+    """Build-or-reuse the stacked view of ``field``'s ``view`` fragments."""
+    group, subset = ("set", view), tuple(shards)
+    fragments = [field.fragment(s, view) for s in shards]
+    vers = _versions(fragments)
+    hit = _cache_get(field, group, subset, vers)
+    if hit is not None:
+        return hit
+    built = StackedSet(shards, fragments)
+    _cache_put(field, group, subset, vers, built)
+    return built
+
+
+def stacked_bsi(field, shards: Sequence[int]) -> StackedBSI:
+    group, subset = ("bsi",), tuple(shards)
+    fragments = [field.bsi_fragment(s) for s in shards]
+    vers = _versions(fragments)
+    hit = _cache_get(field, group, subset, vers)
+    if hit is not None:
+        return hit
+    built = StackedBSI(shards, fragments)
+    _cache_put(field, group, subset, vers, built)
+    return built

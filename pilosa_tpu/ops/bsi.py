@@ -288,6 +288,60 @@ def _assemble(bits, negative) -> int:
     return -v if negative else v
 
 
+@jax.jit
+def _kth_kernel(planes, filt, nth_times_100):
+    """Select the value at percentile ``nth`` (0..100, scaled x100 as an
+    int32 to stay float-free) of the filtered columns — entirely on device.
+
+    The reference binary-searches count(<=v) over the value range with one
+    query per probe (executor.go:1310 executePercentile); over a tunneled
+    TPU that is ~40 round-trips. Here the MSB->LSB bit descent picks each
+    result bit with two popcounts, all fused into one dispatch:
+
+    ascending order = negatives by descending magnitude, then positives by
+    ascending magnitude; rank r = max(1, ceil(nth/100 * total)). If
+    r <= #neg we want the r-th largest magnitude among the negatives
+    (rank 1 = most negative), else the (r - #neg)-th smallest magnitude
+    among the positives.
+
+    Returns (bits bool[depth] LSB-first, negative, count_of_value, total).
+    """
+    exists = planes[EXISTS] & filt
+    sign = planes[SIGN]
+    mags = planes[OFFSET:]
+    depth = mags.shape[0]
+    neg = exists & sign
+    pos = exists & ~sign
+    neg_n = jnp.sum(_pc(neg))
+    total = neg_n + jnp.sum(_pc(pos))
+    # ceil(nth/100 * total) in int32 without overflow: split total into
+    # q*10000 + rem so every intermediate stays < max(total, 10^8)
+    # (nth_x100 * total directly would wrap int32 past ~215k values).
+    q, rem = total // 10000, total % 10000
+    rank = nth_times_100 * q + (nth_times_100 * rem + 9999) // 10000
+    rank = jnp.clip(rank, 1, total)
+    is_neg = rank <= neg_n
+    S = jnp.where(is_neg, neg, pos)
+    # within-class rank, counted from the large-magnitude end for negatives
+    # and the small-magnitude end for positives
+    k = jnp.where(is_neg, rank, rank - neg_n)
+    bits = []
+    for d in range(depth - 1, -1, -1):
+        hi = S & mags[d]
+        lo = S & ~mags[d]
+        c_hi = jnp.sum(_pc(hi))
+        c_lo = jnp.sum(_pc(lo))
+        # negatives walk large->small (take the bit=1 side first);
+        # positives walk small->large (take the bit=0 side first).
+        take_hi = jnp.where(is_neg, c_hi >= k, c_lo < k)
+        k = jnp.where(take_hi, jnp.where(is_neg, k, k - c_lo),
+                      jnp.where(is_neg, k - c_hi, k))
+        S = jnp.where(take_hi, hi, lo)
+        bits.append(take_hi)
+    bits.reverse()
+    return jnp.stack(bits), is_neg, jnp.sum(_pc(S)), total
+
+
 def bsi_min(planes, filt):
     """(min stored value, count achieving it, total filtered count).
     Reference: fragment.go:754 minUnsigned/min."""

@@ -63,19 +63,23 @@ def pair_counts(a, b, block_words: int = BLOCK_WORDS):
         a_w, b_w = ab
         a_bits = _expand_bits_bf16(a_w)  # [R1, bw*32]
         b_bits = _expand_bits_bf16(b_w)  # [R2, bw*32]
-        acc = acc + jax.lax.dot_general(
+        # One block's counts are <= bw*32 <= 2^16, exact in f32; the
+        # cross-block accumulator is int32 so totals stay exact past 2^24
+        # (shards are concatenated along W — multi-shard counts reach
+        # S * 2^20, see core/stacked.py).
+        block = jax.lax.dot_general(
             a_bits,
             b_bits,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc, None
+        return acc + block.astype(jnp.int32), None
 
     # Inside shard_map the inputs carry varying-manual-axes type; the scan
     # carry must match or tracing rejects it.
-    acc0 = zeros_varying_like(a, (r1, r2), jnp.float32)
+    acc0 = zeros_varying_like(a, (r1, r2), jnp.int32)
     acc, _ = lax.scan(step, acc0, (a_blocks, b_blocks))
-    return acc.astype(jnp.int32)
+    return acc
 
 
 @jax.jit
@@ -83,3 +87,29 @@ def masked_pair_counts(a, b, filt):
     """pair_counts with both sides pre-intersected by a filter plane
     (reference: GroupBy's optional filter argument, executor.go:3277)."""
     return pair_counts(a & filt[None, :], b & filt[None, :])
+
+
+@jax.jit
+def pair_sums(a, b, mags, pos, neg):
+    """Per-magnitude-plane pair counts for two-field GroupBy with a Sum
+    aggregate: three-way popcounts as matmuls,
+
+        pos_k[i, j] = popcount(A_i & B_j & M_k & pos)
+
+    since popcount(P & Q) = sum_c P[c]*Q[c] with P = A_i & pos,
+    Q = B_j & M_k. The host assembles the exact per-group sum
+    ``sum_k 2^k (pos_k - neg_k)`` with Python ints (reference walks group
+    bitmaps one at a time through fragment.sum, executor.go:3176 +
+    fragment.go:724).
+
+    Returns (pos int32[D, R1, R2], neg int32[D, R1, R2]).
+    """
+    ap = a & pos[None, :]
+    an = a & neg[None, :]
+
+    def step(_, mk):
+        bm = b & mk[None, :]
+        return None, (pair_counts(ap, bm), pair_counts(an, bm))
+
+    _, (p, n) = lax.scan(step, None, mags)
+    return p, n

@@ -1,24 +1,28 @@
-"""PQL executor: lowers the call tree to L0 kernels, per-shard map +
-monoid reduce.
+"""PQL executor: lowers the call tree to batched L0 kernels over stacked
+shard tensors, with ONE host round-trip per query.
 
 Reference: executor.go — one ``execute*`` / ``execute*Shard`` pair per call
 (dispatch executor.go:679-841), shard fan-out via mapReduce
-(executor.go:6449). Here the "map" is a kernel launch per shard-fragment
-(device arrays) and the "reduce" is the same monoid the reference uses
-(sum for Count, min/max merge, dict-merge for TopN/GroupBy). Key
-translation happens host-side around kernels (reference: executor.go:6814
-preTranslate, :7519 translateResults) — strings never reach the device.
+(executor.go:6449). The reference maps per shard and reduces on the
+coordinator; here the per-node "map" is ONE XLA dispatch over all local
+shards at once: fragments are stacked along the column/word axis
+(core/stacked.py — every kernel reduces over columns, so concatenated
+shards ARE the monoid reduce), and results come back in a single deferred
+device->host fetch per query (critical on tunneled TPUs where each
+blocking fetch is a full round-trip).
 
-Single-process execution; the multi-device mesh path lives in
-pilosa_tpu/parallel and is used when shards are device-resident stacked
-(SURVEY.md §5.8 TPU-native equivalent).
+Key translation happens host-side around kernels (reference:
+executor.go:6814 preTranslate, :7519 translateResults) — strings never
+reach the device. Cross-node distribution lives in cluster/executor.py and
+reuses the same monoid reduce shapes.
 """
 
 from __future__ import annotations
 
 import datetime as dt
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,9 +31,10 @@ from pilosa_tpu.core.field import Field
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import EXISTENCE_ROW, Index
 from pilosa_tpu.core.schema import FieldType
+from pilosa_tpu.core.stacked import StackedBSI, StackedSet, stacked_bsi, stacked_set
 from pilosa_tpu.ops import bitmap as B
 from pilosa_tpu.ops import bsi as S
-from pilosa_tpu.ops.groupby import pair_counts
+from pilosa_tpu.ops.groupby import pair_counts, pair_sums
 from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS
 from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.pql import result as R
@@ -53,6 +58,28 @@ def _parse_ts(v) -> dt.datetime:
     if isinstance(v, dt.datetime):
         return v
     return dt.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+
+
+class _Deferred:
+    """A query result whose device arrays haven't been fetched yet.
+
+    ``execute`` starts async copies for every deferred result of the query
+    before blocking on any of them, so N top-level calls cost one
+    round-trip, not N (the analog of the reference answering all calls of
+    a request in one HTTP response)."""
+
+    __slots__ = ("arrays", "finalize")
+
+    def __init__(self, arrays: Sequence[jax.Array], finalize: Callable):
+        self.arrays = list(arrays)
+        self.finalize = finalize
+
+    def resolve(self):
+        return self.finalize(*[np.asarray(a) for a in self.arrays])
+
+
+def _resolve(value):
+    return value.resolve() if isinstance(value, _Deferred) else value
 
 
 class Executor:
@@ -79,7 +106,16 @@ class Executor:
             query = parse(query)
         if isinstance(query, Call):
             query = Query([query])
-        return [self._execute_call(idx, call, shards) for call in query.calls]
+        raw = [self._execute_call(idx, call, shards) for call in query.calls]
+        # Overlap all device->host copies, then block once.
+        for r in raw:
+            if isinstance(r, _Deferred):
+                for a in r.arrays:
+                    try:
+                        a.copy_to_host_async()
+                    except AttributeError:  # non-array leaf
+                        pass
+        return [_resolve(r) for r in raw]
 
     # -- dispatch (reference: executor.go:679 executeCall) --------------------
 
@@ -118,21 +154,19 @@ class Executor:
             return sorted(shards)
         return sorted(idx.shards())
 
-    def _zero(self, words: int = WORDS_PER_SHARD) -> jnp.ndarray:
+    def _zero(self, words: int) -> jnp.ndarray:
         z = self._zeros.get(words)
         if z is None:
             z = self._zeros[words] = jnp.zeros((words,), dtype=jnp.uint32)
         return z
 
-    def _existence(self, idx: Index, shard: int) -> jnp.ndarray:
+    def _existence_all(self, idx: Index, shard_list: List[int]) -> jnp.ndarray:
         ex = idx.existence
         if ex is None:
             raise PQLError(
                 f"index {idx.name!r} does not track existence; Not/All need it")
-        frag = ex.fragment(shard)
-        if frag is None:
-            return self._zero()
-        return frag.device_row(EXISTENCE_ROW)
+        st = stacked_set(ex, shard_list, timeq.VIEW_STANDARD)
+        return st.row_plane(EXISTENCE_ROW)
 
     # -- row/column key resolution ---------------------------------------------
 
@@ -161,23 +195,26 @@ class Executor:
             return idx.translate.find_keys([value]).get(value)
         return int(value)
 
-    # -- bitmap evaluation (reference: executor.go:1782
-    #    executeBitmapCallShard) --------------------------------------------
+    # -- batched bitmap evaluation ---------------------------------------------
+    # The analog of executor.go:1782 executeBitmapCallShard, but over ALL
+    # shards at once: planes are uint32[len(shards)*WORDS_PER_SHARD].
 
-    def _eval(self, idx: Index, call: Call, shard: int) -> jnp.ndarray:
+    def _eval_all(self, idx: Index, call: Call, shard_list: List[int]
+                  ) -> jnp.ndarray:
+        total_words = len(shard_list) * WORDS_PER_SHARD
         name = call.name
         if name == "Row":
-            return self._eval_row(idx, call, shard)
+            return self._eval_row(idx, call, shard_list)
         if name == "Union":
-            planes = [self._eval(idx, c, shard) for c in call.children]
-            out = planes[0] if planes else self._zero()
+            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
+            out = planes[0] if planes else self._zero(total_words)
             for p in planes[1:]:
                 out = B.plane_or(out, p)
             return out
         if name == "Intersect":
             if not call.children:
                 raise PQLError("Intersect requires at least one child")
-            planes = [self._eval(idx, c, shard) for c in call.children]
+            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
             out = planes[0]
             for p in planes[1:]:
                 out = B.plane_and(out, p)
@@ -185,103 +222,114 @@ class Executor:
         if name == "Difference":
             if not call.children:
                 raise PQLError("Difference requires at least one child")
-            out = self._eval(idx, call.children[0], shard)
+            out = self._eval_all(idx, call.children[0], shard_list)
             for c in call.children[1:]:
-                out = B.plane_andnot(out, self._eval(idx, c, shard))
+                out = B.plane_andnot(out, self._eval_all(idx, c, shard_list))
             return out
         if name == "Xor":
-            planes = [self._eval(idx, c, shard) for c in call.children]
-            out = planes[0] if planes else self._zero()
+            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
+            out = planes[0] if planes else self._zero(total_words)
             for p in planes[1:]:
                 out = B.plane_xor(out, p)
             return out
         if name == "Not":
-            child = self._eval(idx, call.children[0], shard)
-            return B.plane_andnot(self._existence(idx, shard), child)
+            child = self._eval_all(idx, call.children[0], shard_list)
+            return B.plane_andnot(self._existence_all(idx, shard_list), child)
         if name == "All":
-            return self._existence(idx, shard)
+            return self._existence_all(idx, shard_list)
         if name == "ConstRow":
             cols = [self._col_id(idx, c) for c in call.arg("columns", [])]
-            local = [c % SHARD_WIDTH for c in cols
-                     if c is not None and c // SHARD_WIDTH == shard]
-            return jnp.asarray(B.bits_to_plane(local))
+            plane = np.zeros((len(shard_list), WORDS_PER_SHARD), dtype=np.uint32)
+            pos = {s: i for i, s in enumerate(shard_list)}
+            by_shard: Dict[int, List[int]] = {}
+            for c in cols:
+                if c is None:
+                    continue
+                si = pos.get(c // SHARD_WIDTH)
+                if si is not None:
+                    by_shard.setdefault(si, []).append(c % SHARD_WIDTH)
+            for si, locals_ in by_shard.items():
+                plane[si] = B.bits_to_plane(locals_)
+            return jnp.asarray(plane.reshape(total_words))
         if name == "UnionRows":
-            out = self._zero()
+            out = self._zero(total_words)
             for c in call.children:
                 if c.name != "Rows":
                     raise PQLError("UnionRows children must be Rows calls")
                 field = idx.field(self._field_name(c))
-                for row in self._rows_list(idx, c):
-                    frag = field.fragment(shard)
-                    if frag is not None:
-                        out = B.plane_or(out, frag.device_row(row))
+                st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
+                if (c.arg("limit") is None and c.arg("previous") is None
+                        and c.arg("column") is None):
+                    rows = st.row_ids  # empty rows OR in nothing
+                else:
+                    rows = self._rows_list(idx, c, shard_list)
+                out = B.plane_or(out, st.rows_plane(rows))
             return out
         if name == "Shift":
-            out = self._eval(idx, call.children[0], shard)
+            out = self._eval_all(idx, call.children[0], shard_list)
+            shaped = out.reshape(len(shard_list), WORDS_PER_SHARD)
             for _ in range(int(call.arg("n", 1))):
-                out = B.plane_shift(out)
-            return out
+                # carries stop at shard boundaries, matching the
+                # reference's per-shard executeShiftShard
+                shaped = jax.vmap(B.plane_shift)(shaped)
+            return shaped.reshape(total_words)
         if name == "Distinct":
-            return self._eval_distinct_plane(idx, call, shard)
+            raise PQLError("Distinct cannot be nested inside bitmap calls yet")
         if name == "Limit":
             raise PQLError("Limit is only valid at the top level of a query")
         raise PQLError(f"call {name!r} does not return a bitmap")
 
-    def _eval_row(self, idx: Index, call: Call, shard: int) -> jnp.ndarray:
+    def _eval_row(self, idx: Index, call: Call, shard_list: List[int]
+                  ) -> jnp.ndarray:
         fa = call.field_arg(exclude=ROW_OPTIONS)
         if fa is None:
             raise PQLError("Row requires a field argument")
         fname, value = fa
         field = idx.field(fname)
         if isinstance(value, Condition) or field.options.type.is_bsi:
-            return self._eval_bsi_row(field, value, shard)
+            return self._eval_bsi_row(field, value, shard_list)
         row = self._row_id(field, value)
+        total_words = len(shard_list) * WORDS_PER_SHARD
         if row is None:  # unknown key -> empty row
-            return self._zero()
+            return self._zero(total_words)
         from_a, to_a = call.arg("from"), call.arg("to")
         if from_a is not None or to_a is not None:
             views = field.range_views(
                 _parse_ts(from_a) if from_a is not None else None,
                 _parse_ts(to_a) if to_a is not None else None,
             )
-            out = self._zero()
+            out = self._zero(total_words)
             for v in views:
-                frag = field.fragment(shard, v)
-                if frag is not None:
-                    out = B.plane_or(out, frag.device_row(row))
+                st = stacked_set(field, shard_list, v)
+                out = B.plane_or(out, st.row_plane(row))
             return out
-        frag = field.fragment(shard)
-        if frag is None:
-            return self._zero()
-        return frag.device_row(row)
+        st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
+        return st.row_plane(row)
 
-    def _eval_bsi_row(self, field: Field, value, shard: int) -> jnp.ndarray:
+    def _eval_bsi_row(self, field: Field, value, shard_list: List[int]
+                      ) -> jnp.ndarray:
         """BSI range predicate (reference: executor.go executeRowShard BSI
         branch -> fragment.rangeOp, fragment.go:937)."""
         if not field.options.type.is_bsi:
             raise PQLError(f"field {field.name!r} is not an int-like field")
-        frag = field.bsi_fragment(shard)
-        if frag is None:
-            return self._zero()
+        st = stacked_bsi(field, shard_list)
         if not isinstance(value, Condition):
             value = Condition("==", value)
         op = _COND_TO_BSI[value.op]
         if value.op == "between":
             lo, hi = value.value
-            return S.bsi_compare(frag.device_planes(), op,
+            return S.bsi_compare(st.planes, op,
                                  field.to_stored(lo), field.to_stored(hi))
         if value.value is None:
             # `!= null` = exists; `== null` = not exists (needs existence).
-            exists = frag.device_planes()[S.EXISTS]
             if value.op == "!=":
-                return exists
+                return st.exists_plane()
             raise PQLError("== null is not supported; use Not(Row(f != null))")
-        return S.bsi_compare(frag.device_planes(), op,
-                             field.to_stored(value.value))
+        return S.bsi_compare(st.planes, op, field.to_stored(value.value))
 
     # -- top-level materialization --------------------------------------------
 
-    def _materialize_row(self, idx: Index, call: Call, shards) -> R.RowResult:
+    def _materialize_row(self, idx: Index, call: Call, shards) -> Any:
         limit, offset = None, 0
         if call.name == "Limit":
             limit = call.arg("limit")
@@ -291,16 +339,24 @@ class Executor:
                 limit, offset = None, 0
         if call.name == "Distinct":
             return self._execute_distinct(idx, call, shards)
-        cols: List[int] = []
-        for shard in self._shards(idx, shards):
-            plane = np.asarray(self._eval(idx, call, shard))
-            base = shard * SHARD_WIDTH
-            cols.extend(int(base + c) for c in B.plane_to_bits(plane))
-        if offset:
-            cols = cols[offset:]
-        if limit is not None:
-            cols = cols[: int(limit)]
-        return self._row_result(idx, cols)
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return self._row_result(idx, [])
+        plane = self._eval_all(idx, call, shard_list)
+
+        def finalize(plane_np: np.ndarray):
+            shaped = plane_np.reshape(len(shard_list), WORDS_PER_SHARD)
+            cols: List[int] = []
+            for si, shard in enumerate(shard_list):
+                base = shard * SHARD_WIDTH
+                cols.extend(int(base + c) for c in B.plane_to_bits(shaped[si]))
+            if offset:
+                cols = cols[offset:]
+            if limit is not None:
+                cols = cols[: int(limit)]
+            return self._row_result(idx, cols)
+
+        return _Deferred([plane], finalize)
 
     def _row_result(self, idx: Index, cols: List[int]) -> R.RowResult:
         if idx.options.keys and not self.remote:
@@ -310,32 +366,30 @@ class Executor:
 
     # -- Count (reference: executor.go:5839 executeCount) ---------------------
 
-    def _execute_count(self, idx: Index, call: Call, shards) -> int:
+    def _execute_count(self, idx: Index, call: Call, shards) -> Any:
         if len(call.children) != 1:
             raise PQLError("Count requires a single child call")
         child = call.children[0]
         if child.name == "Distinct":
-            res = self._execute_distinct(idx, child, shards)
+            res = _resolve(self._execute_distinct(idx, child, shards))
             if isinstance(res, R.RowResult):
                 return len(res.columns or res.keys or [])
             return len(res)
-        total = 0
-        for shard in self._shards(idx, shards):
-            total += int(B.plane_count(self._eval(idx, child, shard)))
-        return total
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return 0
+        count = B.plane_count(self._eval_all(idx, child, shard_list))
+        return _Deferred([count], lambda c: int(c))
 
     # -- BSI aggregates (reference: executor.go executeSum/Min/Max) -----------
 
-    def _agg_filter(self, idx: Index, call: Call, shard: int,
-                    field: Field) -> jnp.ndarray:
+    def _agg_filter(self, idx: Index, call: Call, shard_list: List[int],
+                    st: StackedBSI) -> jnp.ndarray:
         if call.children:
-            return self._eval(idx, call.children[0], shard)
-        frag = field.bsi_fragment(shard)
-        if frag is None:
-            return self._zero()
-        return frag.device_planes()[S.EXISTS]
+            return self._eval_all(idx, call.children[0], shard_list)
+        return st.exists_plane()
 
-    def _execute_bsi_agg(self, idx: Index, call: Call, shards) -> R.ValCount:
+    def _execute_bsi_agg(self, idx: Index, call: Call, shards) -> Any:
         fname = call.arg("field") or call.arg("_field")
         if fname is None:
             raise PQLError(f"{call.name} requires field=")
@@ -344,65 +398,72 @@ class Executor:
             raise PQLError(f"field {fname!r} is not an int-like field")
         shard_list = self._shards(idx, shards)
         if call.name == "Sum":
-            total, count = 0, 0
-            for shard in shard_list:
-                frag = field.bsi_fragment(shard)
-                if frag is None:
-                    continue
-                filt = self._agg_filter(idx, call, shard, field)
-                t, c = S.bsi_sum(frag.device_planes(), filt)
-                total += t
-                count += c
-            # stored = actual - base  =>  sum(actual) = sum(stored) + base*n
-            val = total + field.options.base * count
-            if field.options.type == FieldType.DECIMAL:
-                val = val / (10 ** field.options.scale)
-            return R.ValCount(val=val, count=count)
-        # Min / Max merge across shards (monoid reduce, reference:
-        # executor.go executeMinShard/MaxShard + reduce).
-        want_max = call.name == "Max"
-        best: Optional[int] = None
-        best_count = 0
-        for shard in shard_list:
-            frag = field.bsi_fragment(shard)
-            if frag is None:
-                continue
-            filt = self._agg_filter(idx, call, shard, field)
-            fn = S.bsi_max if want_max else S.bsi_min
-            v, c, tot = fn(frag.device_planes(), filt)
-            if tot == 0:
-                continue
-            if best is None or (v > best if want_max else v < best):
-                best, best_count = v, c
-            elif v == best:
-                best_count += c
-        if best is None:
+            if not shard_list:
+                return R.ValCount(val=0, count=0)
+            st = stacked_bsi(field, shard_list)
+            filt = self._agg_filter(idx, call, shard_list, st)
+            count, pos, neg = S.bsi_plane_popcounts(st.planes, filt)
+
+            def fin_sum(count_np, pos_np, neg_np):
+                total = 0
+                for k in range(pos_np.shape[0]):
+                    total += (int(pos_np[k]) - int(neg_np[k])) << k
+                n = int(count_np)
+                # stored = actual - base  =>  sum(actual) = sum(stored)+base*n
+                val = total + field.options.base * n
+                if field.options.type == FieldType.DECIMAL:
+                    val = val / (10 ** field.options.scale)
+                return R.ValCount(val=val, count=n)
+
+            return _Deferred([count, pos, neg], fin_sum)
+        # Min / Max (reference: executor.go executeMinShard/MaxShard); the
+        # stacked layout makes the cross-shard merge implicit.
+        if not shard_list:
             return R.ValCount(val=None, count=0)
-        val = field.from_stored(best)
-        return R.ValCount(val=val, count=best_count)
+        want_max = call.name == "Max"
+        st = stacked_bsi(field, shard_list)
+        filt = self._agg_filter(idx, call, shard_list, st)
+        bits, negative, cnt, total = S._minmax_kernel(st.planes, filt, want_max)
+
+        def fin_minmax(bits_np, neg_np, cnt_np, total_np):
+            if int(total_np) == 0:
+                return R.ValCount(val=None, count=0)
+            v = 0
+            for k in range(bits_np.shape[0]):
+                if bits_np[k]:
+                    v |= 1 << k
+            if neg_np:
+                v = -v
+            return R.ValCount(val=field.from_stored(v), count=int(cnt_np))
+
+        return _Deferred([bits, negative, cnt, total], fin_minmax)
 
     # -- TopN / TopK (reference: executor.go:2357/2535) ------------------------
 
-    def _execute_topn(self, idx: Index, call: Call, shards) -> R.PairsField:
+    def _execute_topn(self, idx: Index, call: Call, shards) -> Any:
         fname = self._field_name(call)
         field = idx.field(fname)
         n = call.arg("n") or call.arg("k")
-        counts: Dict[int, int] = {}
-        for shard in self._shards(idx, shards):
-            frag = field.fragment(shard)
-            if frag is None or not frag.row_ids:
-                continue
-            filt = (self._eval(idx, call.children[0], shard)
-                    if call.children else None)
-            per_row = np.asarray(B.row_counts(frag.device_planes(), filt))
-            for slot, row in enumerate(frag.row_ids):
-                c = int(per_row[slot])
-                if c:
-                    counts[row] = counts.get(row, 0) + c
-        ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
-        if n is not None and not self.remote:
-            ranked = ranked[: int(n)]
-        return self._pairs_field(field, ranked)
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return self._pairs_field(field, [])
+        st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
+        if not st.row_ids:
+            return self._pairs_field(field, [])
+        filt = (self._eval_all(idx, call.children[0], shard_list)
+                if call.children else None)
+        counts = B.row_counts(st.planes, filt)
+
+        def finalize(counts_np: np.ndarray):
+            ranked = [(row, int(counts_np[slot]))
+                      for slot, row in enumerate(st.row_ids)
+                      if counts_np[slot]]
+            ranked.sort(key=lambda kv: (-kv[1], kv[0]))
+            if n is not None and not self.remote:
+                return self._pairs_field(field, ranked[: int(n)])
+            return self._pairs_field(field, ranked)
+
+        return _Deferred([counts], finalize)
 
     def _pairs_field(self, field: Field, ranked: List[Tuple[int, int]]
                      ) -> R.PairsField:
@@ -425,25 +486,26 @@ class Executor:
     def _rows_list(self, idx: Index, call: Call, shards=None) -> List[int]:
         field = idx.field(self._field_name(call))
         col = call.arg("column")
+        shard_list = self._shards(idx, shards)
         rows: set = set()
-        for shard in self._shards(idx, shards):
-            frag = field.fragment(shard)
-            if frag is None:
-                continue
-            if col is not None:
-                c = self._col_id(idx, col)
-                if c is None or c // SHARD_WIDTH != shard:
-                    continue
-                pos = c % SHARD_WIDTH
-                for row in frag.existing_rows():
-                    plane = frag.row_plane(row)
-                    if plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)):
-                        rows.add(row)
-            else:
-                per_row = np.asarray(B.row_counts(frag.device_planes()))
-                for slot, row in enumerate(frag.row_ids):
-                    if per_row[slot]:
-                        rows.add(row)
+        if col is not None:
+            # point lookup: host planes, no device trip
+            c = self._col_id(idx, col)
+            if c is not None and c // SHARD_WIDTH in shard_list:
+                shard = c // SHARD_WIDTH
+                frag = field.fragment(shard)
+                if frag is not None:
+                    pos = c % SHARD_WIDTH
+                    for row in frag.existing_rows():
+                        plane = frag.row_plane(row)
+                        if plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)):
+                            rows.add(row)
+        elif shard_list:
+            st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
+            if st.row_ids:
+                counts = np.asarray(B.row_counts(st.planes))
+                rows = {row for slot, row in enumerate(st.row_ids)
+                        if counts[slot]}
         out = sorted(rows)
         prev = call.arg("previous")
         if prev is not None:
@@ -473,14 +535,19 @@ class Executor:
                 m = field.translate.translate_ids(rows)
                 return R.RowResult(columns=[], keys=[m.get(r, str(r)) for r in rows])
             return R.RowResult(columns=rows)
+        shard_list = self._shards(idx, shards)
+        filt_np = None
+        if call.children and shard_list:
+            filt_np = np.asarray(
+                self._eval_all(idx, call.children[0], shard_list)
+            ).reshape(len(shard_list), WORDS_PER_SHARD)
         vals: set = set()
-        for shard in self._shards(idx, shards):
+        for si, shard in enumerate(shard_list):
             frag = field.bsi_fragment(shard)
             if frag is None:
                 continue
-            filt = (np.asarray(self._eval(idx, call.children[0], shard))
-                    if call.children else None)
-            vals.update(self._decode_distinct(frag, filt))
+            vals.update(self._decode_distinct(
+                frag, filt_np[si] if filt_np is not None else None))
         return sorted(field.from_stored(v) for v in vals)
 
     @staticmethod
@@ -503,12 +570,9 @@ class Executor:
         vals[sign] = -vals[sign]
         return set(int(v) for v in vals)
 
-    def _eval_distinct_plane(self, idx: Index, call: Call, shard: int):
-        raise PQLError("Distinct cannot be nested inside bitmap calls yet")
-
     # -- GroupBy (reference: executor.go:3918 executeGroupByShard) -------------
 
-    def _execute_groupby(self, idx: Index, call: Call, shards) -> List[R.GroupCount]:
+    def _execute_groupby(self, idx: Index, call: Call, shards) -> Any:
         if not call.children:
             raise PQLError("GroupBy requires at least one Rows child")
         rows_calls = [c for c in call.children if c.name == "Rows"]
@@ -523,24 +587,35 @@ class Executor:
                 raise PQLError("GroupBy aggregate must be Sum(...) or Count(...)")
             if agg_call.name == "Sum":
                 agg_field = idx.field(agg_call.arg("field") or agg_call.arg("_field"))
-
-        acc: Dict[tuple, List[int]] = {}  # group key -> [count, agg]
-        for shard in self._shards(idx, shards):
-            self._groupby_shard(idx, fields, filter_call, agg_field, shard, acc)
-
-        out = []
-        for key in sorted(acc):
-            count, agg = acc[key]
-            if count == 0:
-                continue
-            group = [self._field_row(f, r) for f, r in zip(fields, key)]
-            out.append(R.GroupCount(
-                group=group, count=count,
-                agg=agg if agg_field is not None else None))
         limit = call.arg("limit")
-        if limit is not None and not self.remote:
-            out = out[: int(limit)]
-        return out
+        if self.remote:
+            limit = None
+
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return []
+        sts = [stacked_set(f, shard_list, timeq.VIEW_STANDARD) for f in fields]
+        if any(not st.row_ids for st in sts):
+            return []
+        filt = (self._eval_all(idx, filter_call, shard_list)
+                if filter_call is not None else None)
+        agg_st = stacked_bsi(agg_field, shard_list) if agg_field is not None else None
+
+        if len(sts) <= 2 and self._groupby_dense_ok(sts, agg_st):
+            return self._groupby_dense(fields, sts, filt, agg_field, agg_st, limit)
+        return self._groupby_fold(fields, sts, filt, agg_field, agg_st, limit)
+
+    @staticmethod
+    def _groupby_dense_ok(sts, agg_st) -> bool:
+        """The dense path materializes [D, RcapA, RcapB] sum tensors when a
+        Sum aggregate is present — cap its size so high-cardinality
+        GroupBy+Sum falls back to the pruning fold instead of OOMing HBM."""
+        if agg_st is None:
+            return True
+        cells = agg_st.planes.shape[0]
+        for st in sts:
+            cells *= st.planes.shape[0]
+        return cells <= 1 << 24  # 16M int32 cells = 64MB per tensor
 
     def _field_row(self, field: Field, row: int) -> R.FieldRow:
         if field.options.keys and not self.remote:
@@ -548,84 +623,129 @@ class Executor:
             return R.FieldRow(field=field.name, row_key=key)
         return R.FieldRow(field=field.name, row_id=row)
 
-    def _groupby_shard(self, idx: Index, fields: List[Field], filter_call,
-                       agg_field: Optional[Field], shard: int,
-                       acc: Dict[tuple, List[int]]) -> None:
-        # Gather (row_ids, planes) per field for this shard.
-        per_field = []
-        for f in fields:
-            frag = f.fragment(shard)
-            if frag is None or not frag.row_ids:
-                return  # no groups in this shard
-            per_field.append((list(frag.row_ids), frag.device_planes()))
-
-        filt = None
-        if filter_call is not None:
-            filt = self._eval(idx, filter_call, shard)
-
-        # Fold fields left to right keeping group bitmaps on device
-        # (prefix planes), pruning empty groups between levels. The last
-        # level needs no intersection planes when there's no aggregate —
-        # the MXU pair-count matrix IS the result (the win over the
-        # reference's per-pair container walk, executor.go:3176).
-        row_ids0, planes0 = per_field[0]
-        group_planes = planes0[: len(row_ids0)]
-        if filt is not None:
-            group_planes = group_planes & filt[None, :]
-        keys = [(r,) for r in row_ids0]
-        n_levels = len(per_field)
-        for level, (row_ids, planes) in enumerate(per_field[1:], start=1):
-            planes = planes[: len(row_ids)]
-            counts_matrix = np.asarray(pair_counts(group_planes, planes))
-            last = level == n_levels - 1
-            if last and agg_field is None:
-                g_idx, r_idx = np.nonzero(counts_matrix)
-                for g, r in zip(g_idx, r_idx):
-                    key = keys[g] + (row_ids[r],)
-                    acc.setdefault(key, [0, 0])[0] += int(counts_matrix[g, r])
-                return
-            g_idx, r_idx = np.nonzero(counts_matrix)
-            if g_idx.size == 0:
-                return
-            group_planes = group_planes[g_idx] & planes[r_idx]
-            keys = [keys[g] + (row_ids[r],) for g, r in zip(g_idx, r_idx)]
-        counts = np.asarray(B.row_counts(group_planes))
-        if agg_field is not None:
-            sums = self._grouped_sums(agg_field, shard, group_planes)
-        for i, key in enumerate(keys):
-            c = int(counts[i])
-            if not c:
-                continue
-            slot = acc.setdefault(key, [0, 0])
-            slot[0] += c
-            if agg_field is not None:
-                slot[1] += sums[i]
-
-    def _grouped_sums(self, field: Field, shard: int, group_planes) -> List[int]:
-        """Per-group Sum via the MXU: counts[g,k] = popcount(group & mag_k)
-        split by sign (see ops/groupby.py docstring)."""
-        frag = field.bsi_fragment(shard)
-        if frag is None:
-            return [0] * group_planes.shape[0]
-        planes = frag.device_planes()
-        sign = planes[S.SIGN]
-        mags = planes[S.OFFSET:]
-        pos = np.asarray(pair_counts(group_planes, mags & ~sign[None, :]))
-        neg = np.asarray(pair_counts(group_planes, mags & sign[None, :]))
+    def _groupby_emit(self, fields: List[Field], keyed_counts, agg_field,
+                      limit) -> List[R.GroupCount]:
         out = []
-        for g in range(group_planes.shape[0]):
-            total = 0
-            for k in range(mags.shape[0]):
-                total += (int(pos[g, k]) - int(neg[g, k])) << k
-            # base offset applies per present value; count of present values
-            # per group with this field's exists plane is folded into pos[0]
-            # only when base != 0 — handled by caller for now (base=0 default).
-            out.append(total)
+        for key, count, agg in keyed_counts:
+            if count == 0:
+                continue
+            group = [self._field_row(f, r) for f, r in zip(fields, key)]
+            out.append(R.GroupCount(
+                group=group, count=count,
+                agg=agg if agg_field is not None else None))
+        if limit is not None:
+            out = out[: int(limit)]
         return out
+
+    def _groupby_dense(self, fields, sts, filt, agg_field, agg_st, limit):
+        """1- and 2-field GroupBy: the whole result is one dense count
+        tensor — single dispatch, single fetch, no host pruning. The MXU
+        pair-count matmul replaces the reference's per-pair container walk
+        (executor.go:3176)."""
+        a = sts[0].planes
+        if filt is not None:
+            a = B.plane_and(a, filt[None, :])
+        if len(sts) == 1:
+            counts = B.row_counts(a)  # [RcapA]
+            arrays = [counts]
+            if agg_st is not None:
+                sign = agg_st.planes[S.SIGN]
+                mags = agg_st.planes[S.OFFSET:]
+                pos_m = B.plane_andnot(agg_st.exists_plane(), sign)
+                neg_m = B.plane_and(agg_st.exists_plane(), sign)
+                p = pair_counts(a, B.plane_and(mags, pos_m[None, :]))
+                ng = pair_counts(a, B.plane_and(mags, neg_m[None, :]))
+                arrays += [p, ng]
+
+            def fin1(counts_np, p_np=None, ng_np=None):
+                keyed = []
+                for slot, row in enumerate(sts[0].row_ids):
+                    agg = 0
+                    if p_np is not None:
+                        for k in range(p_np.shape[1]):
+                            agg += (int(p_np[slot, k]) - int(ng_np[slot, k])) << k
+                    keyed.append(((row,), int(counts_np[slot]), agg))
+                return self._groupby_emit(fields, keyed, agg_field, limit)
+
+            return _Deferred(arrays, fin1)
+
+        b = sts[1].planes
+        counts = pair_counts(a, b)  # [RcapA, RcapB]
+        arrays = [counts]
+        if agg_st is not None:
+            sign = agg_st.planes[S.SIGN]
+            mags = agg_st.planes[S.OFFSET:]
+            pos_m = B.plane_andnot(agg_st.exists_plane(), sign)
+            neg_m = B.plane_and(agg_st.exists_plane(), sign)
+            p, ng = pair_sums(a, b, mags, pos_m, neg_m)  # [D, RA, RB]
+            arrays += [p, ng]
+
+        def fin2(counts_np, p_np=None, ng_np=None):
+            keyed = []
+            ra = len(sts[0].row_ids)
+            rb = len(sts[1].row_ids)
+            gi, gj = np.nonzero(counts_np[:ra, :rb])
+            for i, j in zip(gi, gj):
+                agg = 0
+                if p_np is not None:
+                    for k in range(p_np.shape[0]):
+                        agg += (int(p_np[k, i, j]) - int(ng_np[k, i, j])) << k
+                keyed.append((
+                    (sts[0].row_ids[i], sts[1].row_ids[j]),
+                    int(counts_np[i, j]), agg))
+            return self._groupby_emit(fields, keyed, agg_field, limit)
+
+        return _Deferred(arrays, fin2)
+
+    def _groupby_fold(self, fields, sts, filt, agg_field, agg_st, limit):
+        """3+ field GroupBy: fold left-to-right keeping group planes on
+        device, pruning empty groups between levels (one fetch per level —
+        the reference pays a full nested iterator walk per shard instead,
+        executor.go:3918)."""
+        n0 = len(sts[0].row_ids)
+        group_planes = sts[0].planes[:n0]
+        if filt is not None:
+            group_planes = B.plane_and(group_planes, filt[None, :])
+        keys = [(r,) for r in sts[0].row_ids]
+        for level, st in enumerate(sts[1:], start=1):
+            nb = len(st.row_ids)
+            counts_matrix = np.asarray(pair_counts(group_planes, st.planes[:nb]))
+            last = level == len(sts) - 1
+            if last and agg_st is None:
+                keyed = []
+                gi, gj = np.nonzero(counts_matrix)
+                for g, r in zip(gi, gj):
+                    keyed.append((keys[g] + (st.row_ids[r],),
+                                  int(counts_matrix[g, r]), 0))
+                keyed.sort(key=lambda kv: kv[0])
+                return self._groupby_emit(fields, keyed, agg_field, limit)
+            gi, gj = np.nonzero(counts_matrix)
+            if gi.size == 0:
+                return []
+            group_planes = group_planes[gi] & st.planes[jnp.asarray(gj)]
+            keys = [keys[g] + (st.row_ids[r],) for g, r in zip(gi, gj)]
+        counts = np.asarray(B.row_counts(group_planes))
+        aggs = [0] * len(keys)
+        if agg_st is not None:
+            sign = agg_st.planes[S.SIGN]
+            mags = agg_st.planes[S.OFFSET:]
+            pos_m = B.plane_andnot(agg_st.exists_plane(), sign)
+            neg_m = B.plane_and(agg_st.exists_plane(), sign)
+            p = np.asarray(pair_counts(group_planes, mags & pos_m[None, :]))
+            ng = np.asarray(pair_counts(group_planes, mags & neg_m[None, :]))
+            for g in range(len(keys)):
+                total = 0
+                for k in range(p.shape[1]):
+                    total += (int(p[g, k]) - int(ng[g, k])) << k
+                aggs[g] = total
+        keyed = sorted(
+            ((keys[g], int(counts[g]), aggs[g]) for g in range(len(keys))),
+            key=lambda kv: kv[0])
+        return self._groupby_emit(fields, keyed, agg_field, limit)
 
     # -- Percentile (reference: executor.go:1310) ------------------------------
 
-    def _execute_percentile(self, idx: Index, call: Call, shards) -> R.ValCount:
+    def _execute_percentile(self, idx: Index, call: Call, shards) -> Any:
         fname = call.arg("field") or call.arg("_field")
         field = idx.field(fname)
         nth = call.arg("nth")
@@ -636,42 +756,26 @@ class Executor:
             raise PQLError("nth must be within [0, 100]")
         filter_call = call.arg("filter")
         shard_list = self._shards(idx, shards)
-
-        def count_le(v: int) -> int:
-            total = 0
-            for shard in shard_list:
-                frag = field.bsi_fragment(shard)
-                if frag is None:
-                    continue
-                plane = S.bsi_compare(frag.device_planes(), S.LE, v)
-                if filter_call is not None:
-                    plane = B.plane_and(plane, self._eval(idx, filter_call, shard))
-                total += int(B.plane_count(plane))
-            return total
-
-        # Min/max bounds via aggregate calls.
-        mn_vc = self._execute_bsi_agg(
-            idx, Call("Min", {"field": fname},
-                      [filter_call] if filter_call else []), shards)
-        mx_vc = self._execute_bsi_agg(
-            idx, Call("Max", {"field": fname},
-                      [filter_call] if filter_call else []), shards)
-        if mn_vc.val is None:
+        if not shard_list:
             return R.ValCount(val=None, count=0)
-        lo, hi = field.to_stored(mn_vc.val), field.to_stored(mx_vc.val)
-        total = count_le(hi)
-        if total == 0:
-            return R.ValCount(val=None, count=0)
-        rank = max(1, int(np.ceil(nth / 100.0 * total))) if nth > 0 else 1
-        # Binary search smallest v with count(<=v) >= rank.
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if count_le(mid) >= rank:
-                hi = mid
-            else:
-                lo = mid + 1
-        cnt = count_le(lo) - (count_le(lo - 1) if lo > field.to_stored(mn_vc.val) else 0)
-        return R.ValCount(val=field.from_stored(lo), count=cnt)
+        st = stacked_bsi(field, shard_list)
+        filt = (self._eval_all(idx, filter_call, shard_list)
+                if filter_call is not None else st.exists_plane())
+        bits, negative, cnt, total = S._kth_kernel(
+            st.planes, filt, jnp.int32(round(nth * 100)))
+
+        def finalize(bits_np, neg_np, cnt_np, total_np):
+            if int(total_np) == 0:
+                return R.ValCount(val=None, count=0)
+            v = 0
+            for k in range(bits_np.shape[0]):
+                if bits_np[k]:
+                    v |= 1 << k
+            if neg_np:
+                v = -v
+            return R.ValCount(val=field.from_stored(v), count=int(cnt_np))
+
+        return _Deferred([bits, negative, cnt, total], finalize)
 
     # -- IncludesColumn (reference: executor.go executeIncludesColumnCall) -----
 
@@ -683,7 +787,16 @@ class Executor:
         if c is None:
             return False
         shard, pos = divmod(c, SHARD_WIDTH)
-        plane = np.asarray(self._eval(idx, call.children[0], shard))
+        # Evaluate over the full shard list so the probe reuses the same
+        # stacked cache entries as every other query — singleton-shard
+        # stacks would thrash the subset LRU (core/stacked.py).
+        shard_list = self._shards(idx, None)
+        if shard not in shard_list:
+            return False
+        si = shard_list.index(shard)
+        plane = np.asarray(
+            self._eval_all(idx, call.children[0], shard_list)
+        ).reshape(len(shard_list), WORDS_PER_SHARD)[si]
         return bool(plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)))
 
     # -- Extract (reference: executor.go:4711 executeExtract) ------------------
@@ -697,9 +810,14 @@ class Executor:
         efields = [R.ExtractedField(name=f.name, type=f.options.type.value)
                    for f in fields]
         columns: List[R.ExtractedColumn] = []
-        for shard in self._shards(idx, shards):
-            plane = np.asarray(self._eval(idx, bitmap_call, shard))
-            local = B.plane_to_bits(plane)
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return R.ExtractedTable(fields=efields, columns=columns)
+        planes_np = np.asarray(
+            self._eval_all(idx, bitmap_call, shard_list)
+        ).reshape(len(shard_list), WORDS_PER_SHARD)
+        for si, shard in enumerate(shard_list):
+            local = B.plane_to_bits(planes_np[si])
             if local.size == 0:
                 continue
             base = shard * SHARD_WIDTH
@@ -754,7 +872,7 @@ class Executor:
 
     # -- writes (reference: executor.go executeSet/Clear/Store) ----------------
 
-    def _execute_write(self, idx: Index, call: Call, shards=None) -> bool:
+    def _execute_write(self, idx: Index, call: Call, shards=None) -> Any:
         name = call.name
         if name == "Set":
             return self._execute_set(idx, call)
@@ -775,14 +893,19 @@ class Executor:
         executeDeleteRecords). Returns the number of records deleted."""
         if not call.children:
             raise PQLError("Delete requires a bitmap child")
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return 0
+        plane = self._eval_all(idx, call.children[0], shard_list)
+        if idx.existence is not None:
+            # count only records that actually exist (reference:
+            # executeDeleteRecords intersects the existence row)
+            plane = B.plane_and(plane, self._existence_all(idx, shard_list))
+        planes_np = np.asarray(plane).reshape(len(shard_list), WORDS_PER_SHARD)
         deleted = 0
-        for shard in self._shards(idx, shards):
-            plane = np.asarray(self._eval(idx, call.children[0], shard))
-            if idx.existence is not None:
-                # count only records that actually exist (reference:
-                # executeDeleteRecords intersects the existence row)
-                plane = plane & np.asarray(self._existence(idx, shard))
-            n = int(B.plane_to_bits(plane).size)
+        for si, shard in enumerate(shard_list):
+            shard_plane = planes_np[si]
+            n = int(B.plane_to_bits(shard_plane).size)
             if n == 0:
                 continue
             deleted += n
@@ -790,10 +913,10 @@ class Executor:
                 for view_frags in field.views.values():
                     frag = view_frags.get(shard)
                     if frag is not None:
-                        frag.clear_plane(plane)
+                        frag.clear_plane(shard_plane)
                 bsi = field.bsi.get(shard)
                 if bsi is not None:
-                    bsi.clear_plane(plane)
+                    bsi.clear_plane(shard_plane)
         return deleted
 
     def _execute_set(self, idx: Index, call: Call) -> bool:
@@ -865,8 +988,13 @@ class Executor:
         if field.options.type.is_bsi:
             raise PQLError("Store targets a set field row")
         row = self._row_id(field, value, create=True)
-        for shard in self._shards(idx, shards):
-            plane = np.asarray(self._eval(idx, call.children[0], shard))
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return True
+        planes_np = np.asarray(
+            self._eval_all(idx, call.children[0], shard_list)
+        ).reshape(len(shard_list), WORDS_PER_SHARD)
+        for si, shard in enumerate(shard_list):
             frag = field.fragment(shard, create=True)
-            frag.import_row_plane(row, plane, clear=True)
+            frag.import_row_plane(row, planes_np[si], clear=True)
         return True

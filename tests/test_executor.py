@@ -395,3 +395,47 @@ class TestErrors:
         h.create_index("i").create_field("f")
         with pytest.raises(PQLError):
             q(e, "i", 'Set(1, f="key")')
+
+
+class TestBatchedRegressions:
+    """Regressions for the stacked/batched execution layer."""
+
+    def test_percentile_large_total_no_overflow(self, env):
+        # rank = ceil(nth/100 * total) must not wrap int32 when computed on
+        # device: 250k values at nth=100 overflows a naive
+        # nth_x100 * total product (ops/bsi.py _kth_kernel).
+        h, e = env
+        idx = h.create_index("p")
+        f = idx.create_field("v", FieldOptions(type=FieldType.INT))
+        n = 250_000
+        cols = list(range(n))
+        f.set_values(cols, [1] * (n - 1) + [5])
+        for c in cols:
+            idx.add_exists(c)
+        assert q(e, "p", "Percentile(field=v, nth=100)")[0].val == 5
+        assert q(e, "p", "Percentile(field=v, nth=50)")[0].val == 1
+
+    def test_groupby_sum_fold_matches_dense(self, env, monkeypatch):
+        # High-cardinality 2-field GroupBy+Sum falls back to the pruning
+        # fold path; its results must match the dense MXU path.
+        h, e = env
+        idx = h.create_index("g")
+        idx.create_field("a")
+        idx.create_field("b")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        pql = ("Set(1, a=1)Set(2, a=1)Set(3, a=2)Set(1, b=10)Set(3, b=10)"
+               "Set(2, b=20)Set(1, v=7)Set(2, v=-3)Set(3, v=100)")
+        q(e, "g", pql)
+        query = "GroupBy(Rows(a), Rows(b), aggregate=Sum(field=v))"
+        dense = q(e, "g", query)[0]
+        monkeypatch.setattr(Executor, "_groupby_dense_ok",
+                            staticmethod(lambda sts, agg_st: False))
+        fold = q(e, "g", query)[0]
+        assert dense == fold
+        by_key = {tuple((g.field, g.row_id) for g in gc.group):
+                  (gc.count, gc.agg) for gc in dense}
+        assert by_key == {
+            (("a", 1), ("b", 10)): (1, 7),
+            (("a", 1), ("b", 20)): (1, -3),
+            (("a", 2), ("b", 10)): (1, 100),
+        }
