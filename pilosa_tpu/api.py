@@ -18,16 +18,19 @@ from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
 from pilosa_tpu.pql.executor import Executor
 from pilosa_tpu.pql.result import result_to_json
-from pilosa_tpu.storage import load_holder_data, save_holder_data
+from pilosa_tpu.storage import save_holder_data
+from pilosa_tpu.storage.txn import TxFactory
 
 
 class API:
-    def __init__(self, path: Optional[str] = None):
-        self.holder = Holder(path)
+    def __init__(self, path: Optional[str] = None, wal_sync: str = "batch"):
+        self.holder = Holder(path, wal_sync=wal_sync)
         self.executor = Executor(self.holder)
+        self.txf = TxFactory(self.holder)
         self._sql_engine = None
         if path:
-            load_holder_data(self.holder)
+            # checkpoint load + WAL replay (reference: rbf/db.go open)
+            self.holder.recover()
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -72,7 +75,8 @@ class API:
 
     def query(self, index: str, pql: str,
               shards: Optional[Sequence[int]] = None) -> List[Any]:
-        return self.executor.execute(index, pql, shards=shards)
+        with self.txf.qcx():  # group-commits any write calls' WAL records
+            return self.executor.execute(index, pql, shards=shards)
 
     def sql(self, query: str):
         """Execute a SQL statement (reference: server/sql.go:17 execSQL).
@@ -111,33 +115,10 @@ class API:
             cols = [m[k] for k in col_keys]
         if len(rows) != len(cols):
             raise ValueError("rows and cols must be the same length")
-        changed = 0
-        if clear:
-            for r, c in zip(rows, cols):
-                changed += fld.clear_bit(int(r), int(c))
-            return changed
-        if fld.options.type in (FieldType.MUTEX, FieldType.BOOL):
-            # Per-bit path so column exclusivity holds (reference:
-            # fragment.go:1787 bulkImportMutex).
-            for r, c in zip(rows, cols):
-                changed += fld.set_bit(int(r), int(c))
-                idx.add_exists(int(c))
-            return changed
-        from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-        by_shard: Dict[int, tuple] = {}
-        for r, c in zip(rows, cols):
-            shard, pos = divmod(int(c), SHARD_WIDTH)
-            by_shard.setdefault(shard, ([], []))
-            by_shard[shard][0].append(int(r))
-            by_shard[shard][1].append(pos)
-        for shard, (rs, ps) in by_shard.items():
-            frag = fld.fragment(shard, create=True)
-            changed += frag.set_many(rs, ps)
-        if idx.options.track_existence:
-            ex = idx.field("_exists")
-            for shard, (rs, ps) in by_shard.items():
-                ex.fragment(shard, create=True).set_many([0] * len(ps), ps)
+        with self.txf.qcx():
+            changed = fld.import_bits(rows, cols, clear=clear)
+            if not clear and idx.options.track_existence:
+                idx.field("_exists").import_bits([0] * len(cols), cols)
         return changed
 
     def import_values(self, index: str, field: str,
@@ -155,17 +136,11 @@ class API:
             cols = [m[k] for k in col_keys]
         if len(cols) != len(values):
             raise ValueError("cols and values must be the same length")
-        fld.set_values([int(c) for c in cols], values)
-        if idx.options.track_existence:
-            ex = idx.field("_exists")
-            from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-            by_shard: Dict[int, list] = {}
-            for c in cols:
-                shard, pos = divmod(int(c), SHARD_WIDTH)
-                by_shard.setdefault(shard, []).append(pos)
-            for shard, ps in by_shard.items():
-                ex.fragment(shard, create=True).set_many([0] * len(ps), ps)
+        with self.txf.qcx():
+            fld.set_values([int(c) for c in cols], values)
+            if idx.options.track_existence:
+                idx.field("_exists").import_bits(
+                    [0] * len(cols), [int(c) for c in cols])
         return len(cols)
 
     def import_roaring(self, index: str, field: str, shard: int,
@@ -177,7 +152,8 @@ class API:
         the fragment in one step."""
         from pilosa_tpu.core import timeq
         from pilosa_tpu.ops.bitmap import bits_to_plane
-        from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
+        from pilosa_tpu.shardwidth import (
+            SHARD_WIDTH, SHARD_WIDTH_EXP, WORDS_PER_SHARD)
         from pilosa_tpu.storage.roaring import decode_to_positions
 
         idx = self.holder.index(index)
@@ -187,28 +163,34 @@ class API:
                 f"field {field!r} is int-like; roaring imports target "
                 "bitmap-row fields")
         all_cols: set = set()
-        for view, blob in views.items():
-            view = view or timeq.VIEW_STANDARD
-            positions = decode_to_positions(blob)
-            rows = (positions >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
-            cols = (positions & np.uint64(SHARD_WIDTH - 1)).astype(np.int64)
-            frag = fld.fragment(shard, view, create=True)
-            for row in np.unique(rows):
-                plane = bits_to_plane(cols[rows == row], frag.words)
-                if clear:
-                    frag.clear_row_plane_bits(int(row), plane)
-                else:
-                    frag.import_row_plane(int(row), plane)
-            all_cols.update(int(c) for c in np.unique(cols))
-        if not clear and idx.options.track_existence and all_cols:
-            ex = idx.field("_exists")
-            ex.fragment(shard, create=True).set_many(
-                [0] * len(all_cols), sorted(all_cols))
+        with self.txf.qcx():
+            for view, blob in views.items():
+                view = view or timeq.VIEW_STANDARD
+                positions = decode_to_positions(blob)
+                rows = (positions >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
+                cols = (positions & np.uint64(SHARD_WIDTH - 1)).astype(np.int64)
+                for row in np.unique(rows):
+                    plane = bits_to_plane(cols[rows == row], WORDS_PER_SHARD)
+                    if clear:
+                        fld.clear_row_plane_bits(shard, int(row), plane,
+                                                 view=view)
+                    else:
+                        fld.write_row_plane(shard, int(row), plane, view=view)
+                all_cols.update(int(c) for c in np.unique(cols))
+            if not clear and idx.options.track_existence and all_cols:
+                base = shard * SHARD_WIDTH
+                idx.field("_exists").import_bits(
+                    [0] * len(all_cols), [base + c for c in sorted(all_cols)])
 
     # -- persistence (reference: backup/restore ctl/backup.go) -------------
 
     def save(self) -> None:
-        save_holder_data(self.holder)
+        """Checkpoint: snapshot all planes and truncate the WALs they
+        subsume (reference: rbf checkpoint, rbf/db.go:149)."""
+        if self.holder.path:
+            self.holder.checkpoint()
+        else:
+            save_holder_data(self.holder)
 
     # -- info --------------------------------------------------------------
 

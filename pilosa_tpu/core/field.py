@@ -13,6 +13,8 @@ import datetime as dt
 import os
 from typing import Dict, Iterable, List, Optional, Set
 
+import numpy as np
+
 from pilosa_tpu.core import timeq
 from pilosa_tpu.core.fragment import BSIFragment, SetFragment
 from pilosa_tpu.core.schema import (
@@ -43,6 +45,10 @@ class Field:
         self.translate = (
             TranslateStore(self._translate_path(), start=1) if options.keys else None
         )
+        # Per-index write-ahead log, attached by the owning Index when the
+        # holder is durable (storage/wal.py). Field-level write methods are
+        # the single logging funnel; fragment methods never log.
+        self.wal = None
 
     def _translate_path(self) -> Optional[str]:
         if self.path is None:
@@ -120,14 +126,21 @@ class Field:
             views += timeq.views_by_time(timestamp, self.options.time_quantum)
         return views
 
+    def _log(self, *record) -> None:
+        if self.wal is not None:
+            self.wal.append(record)
+
     def set_bit(self, row: int, col: int,
                 timestamp: Optional[dt.datetime] = None) -> bool:
         """Set (row, col); mutex/bool clear other rows of the column first
         (reference: fragment.go setBit + mutex handling
         fragment.go:1787)."""
+        views = self._write_views(timestamp)  # validates before logging
+        self._log("set_bit", self.name, row, col,
+                  timestamp.isoformat() if timestamp else None)
         shard, pos = divmod(col, SHARD_WIDTH)
         changed = False
-        for view in self._write_views(timestamp):
+        for view in views:
             frag = self.fragment(shard, view, create=True)
             if self.options.type in (FieldType.MUTEX, FieldType.BOOL):
                 changed |= frag.clear_column(pos, except_row=row)
@@ -135,6 +148,7 @@ class Field:
         return changed
 
     def clear_bit(self, row: int, col: int) -> bool:
+        self._log("clear_bit", self.name, row, col)
         shard, pos = divmod(col, SHARD_WIDTH)
         changed = False
         # Clears apply to every view (reference: fragment clearBit per view).
@@ -148,24 +162,119 @@ class Field:
         return self.set_bit(BOOL_TRUE_ROW if value else BOOL_FALSE_ROW, col)
 
     def set_value(self, col: int, value) -> None:
-        shard, pos = divmod(col, SHARD_WIDTH)
-        frag = self.bsi_fragment(shard, create=True)
-        frag.set_value(pos, self.to_stored(value))
+        self.set_values([col], [value])
 
     def set_values(self, cols: Iterable[int], values: Iterable) -> None:
+        cols = list(cols)
+        values = list(values)
         by_shard: Dict[int, tuple] = {}
+        # Convert (and validate: min/max bounds raise here) BEFORE logging
+        # so a rejected write never poisons the WAL for replay.
         for col, val in zip(cols, values):
             shard, pos = divmod(col, SHARD_WIDTH)
             by_shard.setdefault(shard, ([], []))
             by_shard[shard][0].append(pos)
             by_shard[shard][1].append(self.to_stored(val))
+        # Log *external* values so replay runs through to_stored again
+        # (deterministic; keeps decimal/timestamp conversion in one place).
+        self._log("set_values", self.name, cols, values)
         for shard, (poss, vals) in by_shard.items():
             self.bsi_fragment(shard, create=True).set_values(poss, vals)
 
     def clear_value(self, col: int) -> bool:
+        self._log("clear_value", self.name, col)
         shard, pos = divmod(col, SHARD_WIDTH)
         frag = self.bsi_fragment(shard)
         return frag.clear_value(pos) if frag else False
+
+    def import_bits(self, rows: Iterable[int], cols: Iterable[int],
+                    clear: bool = False) -> int:
+        """Bulk (row, col) import with IDs already translated (reference:
+        fragment.go:1498 bulkImport; mutex variant :1787). Returns changed
+        bit count. The one bulk WAL record replaces per-bit logging."""
+        rows = [int(r) for r in rows]
+        cols = [int(c) for c in cols]
+        if len(rows) != len(cols):
+            raise ValueError("rows and cols must be the same length")
+        changed = 0
+        if clear:
+            # per-bit so every view is cleared; clear_bit logs itself
+            for r, c in zip(rows, cols):
+                changed += self.clear_bit(r, c)
+            return changed
+        if self.options.type in (FieldType.MUTEX, FieldType.BOOL):
+            # Per-bit path so column exclusivity holds; set_bit logs itself
+            # (reference: fragment.go:1787 bulkImportMutex).
+            for r, c in zip(rows, cols):
+                changed += self.set_bit(r, c)
+            return changed
+        self._log("import_bits", self.name, rows, cols)
+        by_shard: Dict[int, tuple] = {}
+        for r, c in zip(rows, cols):
+            shard, pos = divmod(c, SHARD_WIDTH)
+            by_shard.setdefault(shard, ([], []))
+            by_shard[shard][0].append(r)
+            by_shard[shard][1].append(pos)
+        for shard, (rs, ps) in by_shard.items():
+            changed += self.fragment(shard, create=True).set_many(rs, ps)
+        return changed
+
+    def write_row_plane(self, shard: int, row: int, plane,
+                        clear: bool = False,
+                        view: str = timeq.VIEW_STANDARD) -> None:
+        """Merge (OR) or replace one row plane, WAL-logged (the Store /
+        import-roaring write path; reference: fragment.go:2038
+        importRoaring, executor.go executeSetRow)."""
+        from pilosa_tpu.storage.wal import pack_plane
+
+        self._log("row_plane", self.name, view, shard, row,
+                  pack_plane(plane), clear)
+        frag = self.fragment(shard, view, create=True)
+        frag.import_row_plane(row, plane, clear=clear)
+
+    def clear_row_plane_bits(self, shard: int, row: int, plane,
+                             view: str = timeq.VIEW_STANDARD) -> bool:
+        """Clear the bits of ``plane`` from one row (the clear side of a
+        roaring import, reference: fragment.go:2053
+        ImportRoaringClearAndSet)."""
+        from pilosa_tpu.storage.wal import pack_plane
+
+        self._log("clear_row_bits", self.name, view, shard, row,
+                  pack_plane(plane))
+        frag = self.fragment(shard, view)
+        if frag is None:
+            return False
+        return frag.clear_row_plane_bits(row, plane)
+
+    def clear_row(self, row: int) -> bool:
+        """Zero a row across all views and shards (reference: executor.go
+        executeClearRow)."""
+        self._log("clear_row", self.name, row)
+        changed = False
+        for view in list(self.views):
+            for shard, frag in self.views[view].items():
+                if frag.has_row(row):
+                    frag.import_row_plane(
+                        row, np.zeros(frag.words, dtype=np.uint32), clear=True)
+                    changed = True
+        return changed
+
+    def clear_columns(self, shard: int, plane, log: bool = True) -> None:
+        """Clear the columns of ``plane`` from every view fragment and the
+        BSI planes of this shard (record deletion, reference:
+        executor.go:9050 executeDeleteRecords). ``log=False`` when the
+        owning Index already logged one index-level delete record."""
+        if log:
+            from pilosa_tpu.storage.wal import pack_plane
+
+            self._log("clear_cols", self.name, shard, pack_plane(plane))
+        for view_frags in self.views.values():
+            frag = view_frags.get(shard)
+            if frag is not None:
+                frag.clear_plane(plane)
+        bsi = self.bsi.get(shard)
+        if bsi is not None:
+            bsi.clear_plane(plane)
 
     def value(self, col: int):
         shard, pos = divmod(col, SHARD_WIDTH)

@@ -22,12 +22,13 @@ EXISTENCE_ROW = 0
 
 class Index:
     def __init__(self, name: str, options: Optional[IndexOptions] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None, wal=None):
         if not name or not name[0].isalpha() or name != name.lower():
             raise ValueError(f"invalid index name {name!r}")
         self.name = name
         self.options = options or IndexOptions()
         self.path = path
+        self.wal = wal  # per-index write-ahead log (storage/wal.py)
         self.fields: Dict[str, Field] = {}
         # Record keys are partition-hashed so key ownership == shard
         # ownership across a cluster (reference: translate.go:103).
@@ -46,6 +47,7 @@ class Index:
 
     def _create_field_object(self, name: str, options: FieldOptions) -> Field:
         field = Field(self.name, name, options, path=self._field_path(name))
+        field.wal = self.wal
         self.fields[name] = field
         return field
 
@@ -84,6 +86,18 @@ class Index:
         fragment import paths)."""
         if self.options.track_existence:
             self.fields[EXISTENCE_FIELD].set_bit(EXISTENCE_ROW, col)
+
+    def delete_columns(self, shard: int, plane) -> None:
+        """Delete records: clear the columns of ``plane`` from EVERY field
+        (all views + BSI) of this shard with ONE WAL record — per-field
+        logging would write the same compressed plane once per field
+        (reference: executor.go:9050 executeDeleteRecords)."""
+        if self.wal is not None:
+            from pilosa_tpu.storage.wal import pack_plane
+
+            self.wal.append(("delete_cols", "", shard, pack_plane(plane)))
+        for field in self.fields.values():
+            field.clear_columns(shard, plane, log=False)
 
     def existence_plane(self, shard: int):
         """Dense existence row for a shard, or None if untracked."""

@@ -54,16 +54,14 @@ class Batch:
         if not self._records:
             return 0
         n = len(self._records)
-        ids = self._translate_ids()
-        self._import_fields(ids)
-        if self._idx.options.track_existence:
-            ex = self._idx.field("_exists")
-            from pilosa_tpu.shardwidth import SHARD_WIDTH
-            by_shard: Dict[int, List[int]] = {}
-            for c in ids:
-                by_shard.setdefault(c // SHARD_WIDTH, []).append(c % SHARD_WIDTH)
-            for shard, ps in by_shard.items():
-                ex.fragment(shard, create=True).set_many([0] * len(ps), ps)
+        with self.api.txf.qcx():  # one group commit per batch flush
+            ids = self._translate_ids()
+            self._import_fields(ids)
+            if self._idx.options.track_existence:
+                # Field-level so the bits are WAL-logged — a record whose
+                # non-id fields are all None is marked existing ONLY here,
+                # and must survive crash recovery like any other write.
+                self._idx.field("_exists").import_bits([0] * len(ids), ids)
         self._records.clear()
         self.imported += n
         return n

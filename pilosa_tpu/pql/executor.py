@@ -909,14 +909,7 @@ class Executor:
             if n == 0:
                 continue
             deleted += n
-            for field in idx.fields.values():
-                for view_frags in field.views.values():
-                    frag = view_frags.get(shard)
-                    if frag is not None:
-                        frag.clear_plane(shard_plane)
-                bsi = field.bsi.get(shard)
-                if bsi is not None:
-                    bsi.clear_plane(shard_plane)
+            idx.delete_columns(shard, shard_plane)
         return deleted
 
     def _execute_set(self, idx: Index, call: Call) -> bool:
@@ -965,15 +958,17 @@ class Executor:
         row = self._row_id(field, value)
         if row is None:
             return False
+        if shards is None:
+            return field.clear_row(row)
         changed = False
-        shard_list = (sorted(field.shards()) if shards is None
-                      else sorted(set(shards) & field.shards()))
-        for shard in shard_list:
+        shard_set = set(shards) & field.shards()
+        for shard in sorted(shard_set):
             for view in list(field.views):
                 frag = field.fragment(shard, view)
                 if frag is not None and frag.has_row(row):
-                    frag.import_row_plane(
-                        row, np.zeros(frag.words, dtype=np.uint32), clear=True)
+                    field.write_row_plane(
+                        shard, row, np.zeros(frag.words, dtype=np.uint32),
+                        clear=True, view=view)
                     changed = True
         return changed
 
@@ -995,6 +990,5 @@ class Executor:
             self._eval_all(idx, call.children[0], shard_list)
         ).reshape(len(shard_list), WORDS_PER_SHARD)
         for si, shard in enumerate(shard_list):
-            frag = field.fragment(shard, create=True)
-            frag.import_row_plane(row, planes_np[si], clear=True)
+            field.write_row_plane(shard, row, planes_np[si], clear=True)
         return True

@@ -132,7 +132,10 @@ class _Parser:
             if (nk, nt) == ("punct", "="):
                 self.next(); self.next()
                 key = t
-                call.args[key] = self.value(allow_call=True)
+                v = self.value(allow_call=True)
+                # kwarg timestamps (from=/to=) surface as plain ISO text,
+                # same as positional ones (pql/ast.go reserved args)
+                call.args[key] = v.text if isinstance(v, _Timestamp) else v
                 return
             if nk == "op":
                 # field <op> value  [possibly invalid: handled in cond]

@@ -6,5 +6,7 @@ serialized per fragment, with whole-holder save/load and tar snapshots.
 """
 
 from pilosa_tpu.storage.store import load_holder_data, save_holder_data
+from pilosa_tpu.storage.txn import Qcx, TxFactory
+from pilosa_tpu.storage.wal import WAL
 
-__all__ = ["load_holder_data", "save_holder_data"]
+__all__ = ["load_holder_data", "save_holder_data", "WAL", "Qcx", "TxFactory"]

@@ -1,0 +1,59 @@
+"""Qcx / TxFactory: per-request transaction contexts.
+
+Reference: txfactory.go:84 (Qcx) / :384 (TxFactory). The reference
+multiplexes one RBF Tx per (index, shard) touched by a query, group-rolls
+back reads and locally commits writes at ``Qcx.Finish``. In the TPU build
+reads are snapshot-consistent for free (queries run against immutable
+device arrays stacked from the host planes — a write bumps versions and
+the next query re-stacks, core/stacked.py), so the read half of Qcx
+disappears by construction.
+
+What remains is the write half: WAL records buffer in each index's log
+during a request and ``finish()`` issues ONE write barrier per dirty index
+— the group commit that makes a multi-call PQL write request durable as a
+unit (the analog of StartAtomicWriteTx, txfactory.go:344).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from pilosa_tpu.core.holder import Holder
+
+
+class Qcx:
+    """One query/request context. Use as a context manager:
+
+        with txf.qcx() as qcx:
+            ... writes ...
+        # exit -> finish() -> WAL flush (fsync per dirty index)
+    """
+
+    def __init__(self, holder: "Holder"):
+        self.holder = holder
+        self._done = False
+
+    def finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.holder.flush_wals()
+        self.holder.maybe_checkpoint()
+
+    def __enter__(self) -> "Qcx":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+class TxFactory:
+    """Reference: txfactory.go:384. Owns the durability policy for a
+    holder and mints Qcx contexts."""
+
+    def __init__(self, holder: "Holder"):
+        self.holder = holder
+
+    def qcx(self) -> Qcx:
+        return Qcx(self.holder)

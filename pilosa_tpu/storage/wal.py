@@ -1,0 +1,145 @@
+"""Write-ahead log: crash-safe durability for the host-canonical planes.
+
+The reference's durability is RBF's page WAL + checkpoint (rbf/db.go:44,
+WAL copy-back at :149-230) — physical 8KB pages because its storage is a
+mmap B-tree. Here the host store is dense numpy planes snapshotted as npz
+(storage/store.py = the checkpoint), so the WAL logs *logical* write
+operations between checkpoints and recovery replays them through the same
+field-level write methods that produced them (deterministic; the analog of
+DAX's op-level writelogger, dax/writelogger/writelogger.go:22).
+
+Framing per record: ``<u32 crc32 of payload><u32 payload len><payload>``,
+payload = pickle of a plain tuple (host-trusted file, like any DB's WAL).
+A torn tail (crash mid-append) fails the CRC/length check and replay stops
+there — everything before it is intact, matching WAL semantics.
+
+Sync modes (reference: rbf cfg fsync knobs, rbf/cfg/cfg.go):
+- "batch" (default): buffered appends, fsync once per flush() — the group
+  commit issued at the end of each API request (Qcx.finish).
+- "always": fsync every append.
+- "never": OS-buffered only (tests/bulk loads).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+_HDR = struct.Struct("<II")
+
+
+class WAL:
+    def __init__(self, path: str, sync: str = "batch"):
+        if sync not in ("always", "batch", "never"):
+            raise ValueError(f"bad sync mode {sync!r}")
+        self.path = path
+        self.sync = sync
+        self.replaying = False  # when True, writers must not re-log
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, "ab")
+        self._dirty = False
+
+    # -- write side ----------------------------------------------------------
+
+    def append(self, record: Tuple) -> None:
+        if self.replaying:
+            return
+        payload = pickle.dumps(record, protocol=5)
+        self._f.write(_HDR.pack(zlib.crc32(payload), len(payload)))
+        self._f.write(payload)
+        self._dirty = True
+        if self.sync == "always":
+            self.flush()
+
+    def flush(self) -> None:
+        """Group commit: one write barrier for everything appended since
+        the last flush (reference: rbf tx commit fsync)."""
+        if not self._dirty:
+            return
+        self._f.flush()
+        if self.sync != "never":
+            os.fsync(self._f.fileno())
+        self._dirty = False
+
+    @property
+    def size(self) -> int:
+        self._f.flush()
+        return os.path.getsize(self.path)
+
+    def truncate(self) -> None:
+        """Drop all records — called after a checkpoint persisted the
+        planes they produced (reference: rbf/db.go WAL copy-back)."""
+        self.flush()
+        self._f.close()
+        self._f = open(self.path, "wb")
+        if self.sync != "never":
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self.flush()
+        self._f.close()
+
+    # -- read side -----------------------------------------------------------
+
+    def records(self) -> Iterator[Tuple]:
+        """Replay iterator; stops silently at a torn/corrupt tail."""
+        self._f.flush()
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return
+                crc, n = _HDR.unpack(hdr)
+                payload = f.read(n)
+                if len(payload) < n or zlib.crc32(payload) != crc:
+                    return  # torn tail
+                yield pickle.loads(payload)
+
+    def valid_prefix(self) -> int:
+        """Byte length of the intact record prefix."""
+        self._f.flush()
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return good
+                crc, n = _HDR.unpack(hdr)
+                payload = f.read(n)
+                if len(payload) < n or zlib.crc32(payload) != crc:
+                    return good
+                good += _HDR.size + n
+
+    def repair(self) -> None:
+        """Chop a torn tail so post-recovery appends don't land behind
+        garbage (which the next replay would stop at, silently dropping
+        them). Called once after recovery replay."""
+        good = self.valid_prefix()
+        if good == os.path.getsize(self.path):
+            return
+        self._f.close()
+        with open(self.path, "r+b") as f:
+            f.truncate(good)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f = open(self.path, "ab")
+
+
+def pack_plane(plane) -> bytes:
+    """Compressed plane bytes for plane-granular records (Store/Delete);
+    dense zero runs deflate to almost nothing."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(plane, dtype=np.uint32)
+    return zlib.compress(arr.tobytes(), level=1)
+
+
+def unpack_plane(data: bytes, words: int):
+    import numpy as np
+
+    return np.frombuffer(zlib.decompress(data), dtype=np.uint32)[:words].copy()
