@@ -65,7 +65,8 @@ class API:
         self.holder.save_schema()
 
     def delete_field(self, index: str, field: str) -> None:
-        self.holder.index(index).delete_field(field)
+        with self.txf.qcx():  # flushes the delete_field WAL tombstone
+            self.holder.index(index).delete_field(field)
         self.holder.save_schema()
 
     def schema(self) -> List[dict]:
@@ -181,6 +182,40 @@ class API:
                 base = shard * SHARD_WIDTH
                 idx.field("_exists").import_bits(
                     [0] * len(all_cols), [base + c for c in sorted(all_cols)])
+
+    # -- dataframe (reference: apply.go ingest + http_handler.go:506-509) --
+
+    def import_dataframe(self, index: str, shard: int,
+                         shard_ids: Sequence[int],
+                         columns: Dict[str, Sequence]) -> None:
+        """Apply a columnar changeset to one shard's frame (reference:
+        apply.go:400 ShardFile.Process)."""
+        idx = self.holder.index(index)
+        with self.txf.qcx():
+            idx.dataframe.apply_changeset(shard, shard_ids, columns)
+
+    def dataframe_schema(self, index: str) -> List[dict]:
+        return self.holder.index(index).dataframe.schema()
+
+    def dataframe_shard(self, index: str, shard: int) -> dict:
+        """Raw frame contents for one shard (reference: handleGetDataframe)."""
+        frame = self.holder.index(index).dataframe.frames.get(shard)
+        if frame is None:
+            return {"shard": shard, "columns": {}}
+        out = {}
+        for name, col in frame.columns.items():
+            pos = np.nonzero(frame.valid[name])[0]
+            vals = col[pos]
+            out[name] = {
+                "positions": [int(p) for p in pos],
+                "values": [int(v) if col.dtype.kind == "i" else float(v)
+                           for v in vals],
+            }
+        return {"shard": shard, "columns": out}
+
+    def delete_dataframe(self, index: str) -> None:
+        with self.txf.qcx():  # flushes the df_delete WAL tombstone
+            self.holder.index(index).dataframe.delete()
 
     # -- persistence (reference: backup/restore ctl/backup.go) -------------
 

@@ -25,6 +25,13 @@ class Holder:
         # truncate) — the analog of RBF's MaxWALCheckpointSize
         # (rbf/cfg/cfg.go:10-13).
         self.checkpoint_bytes = checkpoint_bytes
+        # Serializes write requests against each other and against
+        # checkpoints (Qcx holds it for the request; reference: RBF's
+        # single-writer tx lock). Reads never take it — they see
+        # version-snapshotted device stacks (core/stacked.py).
+        import threading
+
+        self.write_lock = threading.RLock()
         self.indexes: Dict[str, Index] = {}
         if path:
             os.makedirs(path, exist_ok=True)
@@ -126,15 +133,19 @@ class Holder:
 
     def checkpoint(self) -> None:
         """Persist all planes, then drop the WAL records they subsume
-        (reference: rbf checkpoint copying WAL pages into the DB file)."""
+        (reference: rbf checkpoint copying WAL pages into the DB file).
+        Takes the write lock so a concurrent writer can't append records
+        between the snapshot and the truncate (RLock: a no-op when called
+        from inside the owning Qcx)."""
         if not self.path:
             return
         from pilosa_tpu.storage.store import save_holder_data
 
-        save_holder_data(self)
-        for idx in self.indexes.values():
-            if idx.wal is not None:
-                idx.wal.truncate()
+        with self.write_lock:
+            save_holder_data(self)
+            for idx in self.indexes.values():
+                if idx.wal is not None:
+                    idx.wal.truncate()
 
     def maybe_checkpoint(self) -> bool:
         if self.path and self.wal_bytes() > self.checkpoint_bytes:
@@ -178,6 +189,22 @@ class Holder:
         from pilosa_tpu.storage.wal import unpack_plane
 
         op, fname = rec[0], rec[1]
+        if op == "df_changeset":  # dataframe record, no field name
+            _, _, shard, ids, columns = rec
+            idx.dataframe.apply_changeset(shard, ids, columns, log=False)
+            return
+        if op == "df_delete":  # tombstone: wipe changesets replayed so far
+            idx.dataframe.delete(log=False)
+            return
+        if op == "delete_field":
+            # tombstone: a field deleted (and possibly re-created) after
+            # earlier records were logged — wipe what replay built so far
+            f = idx.fields.get(fname)
+            if f is not None:
+                f.views.clear()
+                f.bsi.clear()
+                f._stacked_cache = {}
+            return
         if op == "delete_cols":  # index-level record, no field name
             _, _, shard, packed = rec
             plane = unpack_plane(packed, WORDS_PER_SHARD)

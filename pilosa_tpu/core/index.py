@@ -38,6 +38,13 @@ class Index:
         )
         if self.options.track_existence:
             self._create_field_object(EXISTENCE_FIELD, FieldOptions(type=FieldType.SET))
+        from pilosa_tpu.dataframe.store import DataframeStore
+
+        self.dataframe = DataframeStore(
+            name,
+            os.path.join(path, "dataframe") if path else None,
+            wal=wal,
+        )
 
     def _translate_path(self) -> Optional[str]:
         return os.path.join(self.path, "keys.jsonl") if self.path else None
@@ -70,6 +77,16 @@ class Index:
         if name == EXISTENCE_FIELD:
             raise ValueError("cannot delete the existence field")
         del self.fields[name]
+        # Tombstone + checkpoint-file removal so neither WAL replay nor
+        # the npz loader resurrects the data into a re-created field of
+        # the same name (mirrors delete_index, holder.py).
+        if self.wal is not None:
+            self.wal.append(("delete_field", name))
+        fpath = self._field_path(name)
+        if fpath and os.path.isdir(fpath):
+            import shutil
+
+            shutil.rmtree(fpath)
 
     def public_fields(self) -> List[Field]:
         return [f for n, f in sorted(self.fields.items()) if n != EXISTENCE_FIELD]
@@ -112,11 +129,13 @@ class Index:
     # -- shards ------------------------------------------------------------------
 
     def shards(self) -> Set[int]:
-        """All shards holding data in any field (reference: the per-field
-        available-shards bitmaps unioned, field.go:454)."""
+        """All shards holding data in any field or the dataframe
+        (reference: the per-field available-shards bitmaps unioned,
+        field.go:454; dataframe shard files, index.go:1035)."""
         out: Set[int] = set()
         for f in self.fields.values():
             out |= f.shards()
+        out.update(self.dataframe.frames)
         return out or {0}
 
     def max_column(self) -> int:

@@ -1,0 +1,20 @@
+"""Dataframe subsystem: per-shard columnar data alongside the bitmaps.
+
+Reference: the experimental Arrow dataframe (apply.go, arrow.go) — per
+shard an Arrow table keyed by shard-local position, queried via PQL
+``Apply(filter?, "ivy program")`` (robpike.io/ivy, an APL interpreter run
+per shard, apply.go:36-120 IvyReduce) and ``Arrow(filter?, header=[..])``
+raw extraction, ingested via POST /index/{i}/dataframe/{shard}
+(apply.go:278 ChangesetRequest).
+
+TPU-native redesign: the per-shard APL interpreter becomes a tiny vector
+expression language (dataframe/expr.py) compiled ONCE to a fused XLA
+kernel over shard-stacked column tensors — the map AND the reduce are a
+single device dispatch (sum/mean/min/max/count over a bitmap-filter mask),
+instead of an interpreter walk per shard plus coordinator concat.
+"""
+
+from pilosa_tpu.dataframe.expr import compile_expr
+from pilosa_tpu.dataframe.store import DataframeStore, ShardFrame
+
+__all__ = ["DataframeStore", "ShardFrame", "compile_expr"]

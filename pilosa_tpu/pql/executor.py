@@ -143,6 +143,16 @@ class Executor:
             return self._execute_includes_column(idx, call)
         if name == "Extract":
             return self._execute_extract(idx, call, shards)
+        if name == "Apply":
+            return self._execute_apply(idx, call, shards)
+        if name == "Arrow":
+            return self._execute_arrow(idx, call, shards)
+        if name == "Sort":
+            return self._execute_sort(idx, call, shards)
+        if name == "FieldValue":
+            return self._execute_field_value(idx, call)
+        if name == "ExternalLookup":
+            return self._execute_external_lookup(idx, call)
         if name in _BITMAP_CALLS:
             return self._materialize_row(idx, call, shards)
         raise PQLError(f"unknown call {name!r}")
@@ -869,6 +879,234 @@ class Executor:
                     rows=[pv[i] for pv in per_field_vals],
                 ))
         return R.ExtractedTable(fields=efields, columns=columns)
+
+    # -- Sort (reference: executor.go:9321 executeSort) ------------------------
+
+    def _execute_sort(self, idx: Index, call: Call, shards) -> R.SortedRow:
+        """Sort(filter?, field=f, sort-desc=bool): record ids ordered by a
+        BSI or bool field's value (reference: executor.go:9387
+        executeSortShard + SortedRow.Merge)."""
+        field = idx.field(self._field_name(call))
+        desc = bool(call.arg("sort-desc", False))
+        shard_list = self._shards(idx, shards)
+        if not shard_list:
+            return R.SortedRow(columns=[], values=[])
+        filt_np = None
+        if call.children:
+            filt_np = np.asarray(
+                self._eval_all(idx, call.children[0], shard_list)
+            ).reshape(len(shard_list), WORDS_PER_SHARD)
+        cols: List[int] = []
+        vals: List[Any] = []
+        if field.options.type == FieldType.BOOL:
+            for si, shard in enumerate(shard_list):
+                frag = field.fragment(shard)
+                if frag is None:
+                    continue
+                base = shard * SHARD_WIDTH
+                for row, v in ((0, False), (1, True)):
+                    plane = frag.row_plane(row).copy()
+                    if filt_np is not None:
+                        plane &= filt_np[si]
+                    for c in B.plane_to_bits(plane):
+                        cols.append(int(base + c))
+                        vals.append(v)
+        elif field.options.type.is_bsi:
+            for si, shard in enumerate(shard_list):
+                frag = field.bsi_fragment(shard)
+                if frag is None:
+                    continue
+                exists = frag.planes[S.EXISTS]
+                if filt_np is not None:
+                    exists = exists & filt_np[si]
+                base = shard * SHARD_WIDTH
+                pos = B.plane_to_bits(exists)
+                if pos.size == 0:
+                    continue
+                # bulk plane decode — one numpy gather per magnitude
+                # plane, not a per-column Python walk
+                w = (pos // 32).astype(np.int64)
+                b = (pos % 32).astype(np.uint32)
+                raw = np.zeros(pos.size, dtype=np.int64)
+                for k in range(frag.depth):
+                    bits = (frag.planes[S.OFFSET + k][w] >> b) & 1
+                    raw |= bits.astype(np.int64) << k
+                sgn = ((frag.planes[S.SIGN][w] >> b) & 1).astype(bool)
+                raw[sgn] = -raw[sgn]
+                cols.extend(int(base + p) for p in pos)
+                vals.extend(field.from_stored(int(v)) for v in raw)
+        else:
+            raise PQLError(
+                f"Sort supports bool and int-like fields, not "
+                f"{field.options.type.value}")
+        order = sorted(range(len(cols)),
+                       key=lambda i: (vals[i], cols[i]), reverse=desc)
+        limit = call.arg("limit")
+        if limit is not None and not self.remote:
+            order = order[: int(limit)]
+        sorted_cols = [cols[i] for i in order]
+        keys = None
+        if idx.options.keys and not self.remote:
+            m = idx.translate.translate_ids(sorted_cols)
+            keys = [m.get(c, str(c)) for c in sorted_cols]
+        return R.SortedRow(columns=sorted_cols,
+                           values=[vals[i] for i in order], keys=keys)
+
+    # -- FieldValue (reference: executor.go:942 executeFieldValueCall) ---------
+
+    def _execute_field_value(self, idx: Index, call: Call) -> R.ValCount:
+        fname = call.arg("field") or call.arg("_field")
+        if not fname:
+            raise PQLError("FieldValue requires field=")
+        col = call.arg("column")
+        if col is None:
+            raise PQLError("FieldValue requires column=")
+        field = idx.field(fname)
+        c = self._col_id(idx, col)
+        if c is None:
+            return R.ValCount(val=None, count=0)
+        if field.options.type == FieldType.BOOL:
+            shard, pos = divmod(c, SHARD_WIDTH)
+            frag = field.fragment(shard)
+            if frag is None:
+                return R.ValCount(val=None, count=0)
+            w, b = divmod(pos, 32)
+            for row in (1, 0):
+                if frag.row_plane(row)[w] & (np.uint32(1) << np.uint32(b)):
+                    return R.ValCount(val=bool(row), count=1)
+            return R.ValCount(val=None, count=0)
+        if not field.options.type.is_bsi:
+            raise PQLError("FieldValue requires an int-like or bool field")
+        v = field.value(c)
+        if v is None:
+            return R.ValCount(val=None, count=0)
+        return R.ValCount(val=v, count=1)
+
+    # -- ExternalLookup (reference: executor.go executeExternalLookup — a
+    #    pass-through to an operator-configured external database) -------------
+
+    external_lookup = None  # plug point: fn(query: str, write: bool) -> Any
+
+    def _execute_external_lookup(self, idx: Index, call: Call) -> Any:
+        if self.external_lookup is None:
+            raise PQLError(
+                "ExternalLookup requires an external lookup backend "
+                "(reference: server --lookup-db-dsn); none is configured")
+        return self.external_lookup(call.arg("query"),
+                                    bool(call.arg("write", False)))
+
+    # -- Apply / Arrow (dataframe; reference: apply.go:121 executeApply,
+    #    arrow.go:36 executeArrow) ---------------------------------------------
+
+    _apply_cache: Dict[str, Any] = {}
+
+    def _execute_apply(self, idx: Index, call: Call, shards) -> Any:
+        """Apply(filter?, "expr"): the expression (dataframe/expr.py — the
+        ivy replacement) compiles once to a fused kernel over shard-stacked
+        columns; map + cross-shard reduce are ONE dispatch."""
+        import jax as _jax
+
+        from pilosa_tpu.dataframe.expr import compile_expr
+
+        # the expression string may land in _ivy (reference's reserved
+        # arg), in _args (after a filter child), or in _col (no filter)
+        src = call.arg("_ivy") or call.arg("_args", [None])[0]
+        if not isinstance(src, str):
+            src = call.arg("_col")
+        if not isinstance(src, str):
+            raise PQLError('Apply requires an expression string argument')
+        if len(call.children) > 1:
+            raise PQLError("Apply() accepts a single bitmap filter")
+        shard_list = self._shards(idx, shards)
+        df_shards = [s for s in shard_list if s in idx.dataframe.frames]
+        compiled = self._apply_cache.get(src)
+        if compiled is None:
+            fn, cols_used, is_red = compile_expr(src)
+            compiled = self._apply_cache[src] = (
+                _jax.jit(fn), sorted(cols_used), is_red)
+            while len(self._apply_cache) > 64:
+                self._apply_cache.pop(next(iter(self._apply_cache)))
+        fn, cols_used, is_red = compiled
+        if not df_shards:
+            return R.ApplyResult(value=0 if is_red else [])
+        cols, valid, cap = idx.dataframe.device_columns(cols_used, df_shards)
+        mask = valid
+        if call.children:
+            plane = self._eval_all(idx, call.children[0], df_shards)
+            mask = mask & self._plane_to_mask(plane, len(df_shards), cap)
+        out = fn(cols, mask)
+
+        if is_red:
+            def fin_scalar(v):
+                x = v.item() if hasattr(v, "item") else v
+                return R.ApplyResult(value=x)
+            return _Deferred([out], fin_scalar)
+
+        def fin_vector(vec, mask_np):
+            vals = vec[mask_np]
+            return R.ApplyResult(value=[float(x) for x in vals])
+
+        return _Deferred([out, mask], fin_vector)
+
+    @staticmethod
+    def _plane_to_mask(plane: jnp.ndarray, n_shards: int, cap: int
+                       ) -> jnp.ndarray:
+        """Expand a [S*W] bitmap plane into bool[S, cap] positions (the
+        filter side of Apply/Arrow; LSB-first like ops/bitmap.py)."""
+        words = plane.reshape(n_shards, WORDS_PER_SHARD)
+        need_words = (cap + 31) // 32
+        words = words[:, :need_words]
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        bits = (words[:, :, None] >> shifts) & jnp.uint32(1)
+        return bits.reshape(n_shards, need_words * 32)[:, :cap] != 0
+
+    def _execute_arrow(self, idx: Index, call: Call, shards) -> R.ArrowTable:
+        """Arrow(filter?, header=[...]): raw column extraction (reference:
+        arrow.go:366 executeArrowShard + header filterColumns)."""
+        header = call.arg("header")
+        shard_list = self._shards(idx, shards)
+        df_shards = [s for s in shard_list if s in idx.dataframe.frames]
+        schema = idx.dataframe.schema()
+        if header:
+            schema = [c for c in schema if c["name"] in set(header)]
+        names = [c["name"] for c in schema]
+        fields = [R.ExtractedField(name=c["name"], type=c["type"])
+                  for c in schema]
+        if not df_shards or not names:
+            return R.ArrowTable(fields=fields, columns=[[] for _ in names])
+        filt_np = None
+        if call.children:
+            filt_np = np.asarray(
+                self._eval_all(idx, call.children[0], df_shards)
+            ).reshape(len(df_shards), WORDS_PER_SHARD)
+        ids: List[int] = []
+        out_cols: List[List[Any]] = [[] for _ in names]
+        for si, shard in enumerate(df_shards):
+            frame = idx.dataframe.frames[shard]
+            n = frame.length()
+            present = np.zeros(n, dtype=bool)
+            for name in names:
+                v = frame.valid.get(name)
+                if v is not None:
+                    present[: v.size] |= v[:n]
+            if filt_np is not None:
+                fbits = np.unpackbits(
+                    filt_np[si].view(np.uint8), bitorder="little")[:n]
+                present &= fbits.astype(bool)
+            pos = np.nonzero(present)[0]
+            base = shard * SHARD_WIDTH
+            ids.extend(int(base + p) for p in pos)
+            for ci, name in enumerate(names):
+                col = frame.columns.get(name)
+                v = frame.valid.get(name)
+                for p in pos:
+                    if col is not None and p < col.size and v[p]:
+                        x = col[p]
+                        out_cols[ci].append(
+                            int(x) if col.dtype.kind == "i" else float(x))
+                    else:
+                        out_cols[ci].append(None)
+        return R.ArrowTable(fields=fields, columns=out_cols, ids=ids)
 
     # -- writes (reference: executor.go executeSet/Clear/Store) ----------------
 

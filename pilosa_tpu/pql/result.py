@@ -121,6 +121,47 @@ class ExtractedTable:
         }
 
 
+@dataclasses.dataclass
+class SortedRow:
+    """Sort() output (reference: executor.go:9321 executeSort SortedRow):
+    record ids ordered by a field's value, with the values alongside."""
+    columns: List[int]
+    values: List[Any]
+    keys: Optional[List[str]] = None
+
+    def to_json(self) -> dict:
+        out = {"columns": self.columns, "values": self.values}
+        if self.keys is not None:
+            out["keys"] = self.keys
+        return out
+
+
+@dataclasses.dataclass
+class ApplyResult:
+    """Apply() output (reference: apply.go ApplyResult = *arrow.Column):
+    a scalar for reductions, else the masked per-record vector."""
+    value: Any  # float/int scalar, or List[float]
+
+    def to_json(self) -> Any:
+        return self.value
+
+
+@dataclasses.dataclass
+class ArrowTable:
+    """Arrow() output (reference: arrow.go:110 BasicTable JSON marshal):
+    named typed columns for the filtered records."""
+    fields: List[ExtractedField]
+    columns: List[List[Any]]  # one list per field, aligned with ids
+    ids: List[int] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "fields": [dataclasses.asdict(f) for f in self.fields],
+            "columns": self.columns,
+            "ids": self.ids,
+        }
+
+
 def result_to_json(r) -> Any:
     if hasattr(r, "to_json"):
         return r.to_json()
@@ -155,6 +196,15 @@ def result_to_wire(r) -> dict:
                 "fields": [dataclasses.asdict(f) for f in r.fields],
                 "columns": [{"column": c.column, "key": c.key, "rows": c.rows}
                             for c in r.columns]}
+    if isinstance(r, ApplyResult):
+        return {"type": "apply", "data": r.value}
+    if isinstance(r, SortedRow):
+        return {"type": "sorted", "columns": r.columns, "values": r.values,
+                "keys": r.keys}
+    if isinstance(r, ArrowTable):
+        return {"type": "arrow",
+                "fields": [dataclasses.asdict(f) for f in r.fields],
+                "columns": r.columns, "ids": r.ids}
     if isinstance(r, list):
         if r and isinstance(r[0], GroupCount):
             return {"type": "groupcounts", "data": [
@@ -186,4 +236,12 @@ def result_from_wire(d: dict) -> Any:
         return [GroupCount(group=[FieldRow(**fr) for fr in gc["group"]],
                            count=gc["count"], agg=gc.get("agg"))
                 for gc in d["data"]]
+    if t == "apply":
+        return ApplyResult(value=d["data"])
+    if t == "sorted":
+        return SortedRow(columns=d["columns"], values=d["values"],
+                         keys=d.get("keys"))
+    if t == "arrow":
+        return ArrowTable(fields=[ExtractedField(**f) for f in d["fields"]],
+                          columns=d["columns"], ids=d.get("ids", []))
     raise ValueError(f"unknown wire result type {t!r}")

@@ -52,6 +52,11 @@ _ROUTES = [
      "post_import_roaring"),
     ("POST", re.compile(r"^/index/([^/]+)/import$"), "post_import"),
     ("POST", re.compile(r"^/index/([^/]+)/import-values$"), "post_import_values"),
+    # dataframe (reference: http_handler.go:506-509)
+    ("POST", re.compile(r"^/index/([^/]+)/dataframe/(\d+)$"), "post_dataframe"),
+    ("GET", re.compile(r"^/index/([^/]+)/dataframe/(\d+)$"), "get_dataframe"),
+    ("GET", re.compile(r"^/index/([^/]+)/dataframe$"), "get_dataframe_schema"),
+    ("DELETE", re.compile(r"^/index/([^/]+)/dataframe$"), "delete_dataframe"),
     ("POST", re.compile(r"^/index/([^/]+)$"), "post_index"),
     ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
     ("POST", re.compile(r"^/sql$"), "post_sql"),
@@ -160,6 +165,26 @@ class Handler(BaseHTTPRequestHandler):
 
     def delete_field(self, index: str, field: str):
         self.api.delete_field(index, field)
+        self._send(200, {"success": True})
+
+    def post_dataframe(self, index: str, shard: str):
+        """Changeset ingest (reference: http_handler.go:506
+        handlePostDataframe; apply.go:278 ChangesetRequest). Body:
+        {"shard_ids": [...], "columns": {name: [values]}}."""
+        b = self._json_body()
+        self.api.import_dataframe(index, int(shard),
+                                  self._require(b, "shard_ids"),
+                                  self._require(b, "columns"))
+        self._send(200, {"success": True})
+
+    def get_dataframe(self, index: str, shard: str):
+        self._send(200, self.api.dataframe_shard(index, int(shard)))
+
+    def get_dataframe_schema(self, index: str):
+        self._send(200, {"schema": self.api.dataframe_schema(index)})
+
+    def delete_dataframe(self, index: str):
+        self.api.delete_dataframe(index)
         self._send(200, {"success": True})
 
     def post_import(self, index: str):

@@ -61,6 +61,7 @@ def save_holder_data(holder: "Holder") -> None:
                                  f"frag.{shard}.npz"),
                     planes=bfrag.planes,
                 )
+        idx.dataframe.save()
 
 
 def load_holder_data(holder: "Holder") -> None:
@@ -96,6 +97,7 @@ def load_holder_data(holder: "Holder") -> None:
                 bfrag.depth = planes.shape[0] - bsiops.OFFSET
                 bfrag.planes = planes.copy()
                 bfrag.version += 1
+        idx.dataframe.load()
 
 
 def _atomic_savez(path: str, **arrays) -> None:

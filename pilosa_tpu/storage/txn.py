@@ -33,13 +33,21 @@ class Qcx:
     def __init__(self, holder: "Holder"):
         self.holder = holder
         self._done = False
+        # Exclude concurrent writers AND checkpoints for the request: a
+        # checkpoint racing a half-applied multi-call write would snapshot
+        # and truncate records it never persisted. RLock so nested Qcx
+        # (query -> import helpers) is fine.
+        self.holder.write_lock.acquire()
 
     def finish(self) -> None:
         if self._done:
             return
         self._done = True
-        self.holder.flush_wals()
-        self.holder.maybe_checkpoint()
+        try:
+            self.holder.flush_wals()
+            self.holder.maybe_checkpoint()
+        finally:
+            self.holder.write_lock.release()
 
     def __enter__(self) -> "Qcx":
         return self

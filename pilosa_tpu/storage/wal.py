@@ -22,23 +22,29 @@ Sync modes (reference: rbf cfg fsync knobs, rbf/cfg/cfg.go):
 
 from __future__ import annotations
 
-import io
 import os
 import pickle
 import struct
+import threading
 import zlib
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 _HDR = struct.Struct("<II")
 
 
 class WAL:
+    """Single-writer log shared by concurrent request threads — the
+    server handles queries on a ThreadingHTTPServer, so every file
+    mutation holds the instance lock (the reference serializes through
+    RBF's single-writer tx lock instead, rbf/db.go)."""
+
     def __init__(self, path: str, sync: str = "batch"):
         if sync not in ("always", "batch", "never"):
             raise ValueError(f"bad sync mode {sync!r}")
         self.path = path
         self.sync = sync
         self.replaying = False  # when True, writers must not re-log
+        self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._f = open(path, "ab")
         self._dirty = False
@@ -49,15 +55,14 @@ class WAL:
         if self.replaying:
             return
         payload = pickle.dumps(record, protocol=5)
-        self._f.write(_HDR.pack(zlib.crc32(payload), len(payload)))
-        self._f.write(payload)
-        self._dirty = True
-        if self.sync == "always":
-            self.flush()
+        framed = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+        with self._lock:
+            self._f.write(framed)  # one write: no interleaved half-records
+            self._dirty = True
+            if self.sync == "always":
+                self._flush_locked()
 
-    def flush(self) -> None:
-        """Group commit: one write barrier for everything appended since
-        the last flush (reference: rbf tx commit fsync)."""
+    def _flush_locked(self) -> None:
         if not self._dirty:
             return
         self._f.flush()
@@ -65,30 +70,40 @@ class WAL:
             os.fsync(self._f.fileno())
         self._dirty = False
 
+    def flush(self) -> None:
+        """Group commit: one write barrier for everything appended since
+        the last flush (reference: rbf tx commit fsync)."""
+        with self._lock:
+            self._flush_locked()
+
     @property
     def size(self) -> int:
-        self._f.flush()
-        return os.path.getsize(self.path)
+        with self._lock:
+            self._f.flush()
+            return os.path.getsize(self.path)
 
     def truncate(self) -> None:
         """Drop all records — called after a checkpoint persisted the
         planes they produced (reference: rbf/db.go WAL copy-back)."""
-        self.flush()
-        self._f.close()
-        self._f = open(self.path, "wb")
-        if self.sync != "never":
-            self._f.flush()
-            os.fsync(self._f.fileno())
+        with self._lock:
+            self._flush_locked()
+            self._f.close()
+            self._f = open(self.path, "wb")
+            if self.sync != "never":
+                self._f.flush()
+                os.fsync(self._f.fileno())
 
     def close(self) -> None:
-        self.flush()
-        self._f.close()
+        with self._lock:
+            self._flush_locked()
+            self._f.close()
 
     # -- read side -----------------------------------------------------------
 
     def records(self) -> Iterator[Tuple]:
         """Replay iterator; stops silently at a torn/corrupt tail."""
-        self._f.flush()
+        with self._lock:
+            self._f.flush()
         with open(self.path, "rb") as f:
             while True:
                 hdr = f.read(_HDR.size)
@@ -102,7 +117,8 @@ class WAL:
 
     def valid_prefix(self) -> int:
         """Byte length of the intact record prefix."""
-        self._f.flush()
+        with self._lock:
+            self._f.flush()
         good = 0
         with open(self.path, "rb") as f:
             while True:
@@ -120,14 +136,15 @@ class WAL:
         garbage (which the next replay would stop at, silently dropping
         them). Called once after recovery replay."""
         good = self.valid_prefix()
-        if good == os.path.getsize(self.path):
-            return
-        self._f.close()
-        with open(self.path, "r+b") as f:
-            f.truncate(good)
-            f.flush()
-            os.fsync(f.fileno())
-        self._f = open(self.path, "ab")
+        with self._lock:
+            if good == os.path.getsize(self.path):
+                return
+            self._f.close()
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+                f.flush()
+                os.fsync(f.fileno())
+            self._f = open(self.path, "ab")
 
 
 def pack_plane(plane) -> bytes:

@@ -1,0 +1,205 @@
+"""Dataframe subsystem: expression compiler, store, Apply/Arrow PQL.
+
+Reference analogs: apply.go/arrow.go behavior (dataframe_test.go,
+arrow_test.go): changeset ingest per shard, Apply with a filter and a
+program, Arrow extraction with a header, persistence.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.dataframe.expr import ExprError, compile_expr
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def api():
+    a = API()
+    a.create_index("t")
+    a.create_field("t", "seg")
+    return a
+
+
+def fill(api, n=1000, shards=2):
+    rng = np.random.default_rng(42)
+    fares, dists = {}, {}
+    for s in range(shards):
+        ids = rng.choice(SHARD_WIDTH, size=n, replace=False)
+        f = rng.uniform(1, 100, size=n).round(2)
+        d = rng.integers(0, 50, size=n)
+        api.import_dataframe("t", s, [int(i) for i in ids],
+                             {"fare": [float(x) for x in f],
+                              "dist": [int(x) for x in d]})
+        for i, fa, di in zip(ids, f, d):
+            g = s * SHARD_WIDTH + int(i)
+            fares[g] = float(fa)
+            dists[g] = int(di)
+    return fares, dists
+
+
+class TestExpr:
+    def test_compile_and_eval(self):
+        import jax.numpy as jnp
+
+        fn, cols, red = compile_expr("sum(fare * 1.5 + 2)")
+        assert cols == {"fare"} and red
+        fare = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        mask = jnp.asarray([[True, False], [True, True]])
+        got = float(fn({"fare": fare}, mask))
+        assert got == pytest.approx((1 * 1.5 + 2) + (3 * 1.5 + 2) + (4 * 1.5 + 2))
+
+    def test_reducers(self):
+        import jax.numpy as jnp
+
+        fare = jnp.asarray([[1.0, 5.0, 3.0]])
+        mask = jnp.asarray([[True, True, False]])
+        for src, want in [("min(fare)", 1.0), ("max(fare)", 5.0),
+                          ("mean(fare)", 3.0), ("count(fare)", 2)]:
+            fn, _, _ = compile_expr(src)
+            assert float(fn({"fare": fare}, mask)) == pytest.approx(want)
+
+    def test_vector_expr(self):
+        import jax.numpy as jnp
+
+        fn, _, red = compile_expr("fare / 2")
+        assert not red
+        out = fn({"fare": jnp.asarray([[4.0, 6.0]])},
+                 jnp.asarray([[True, False]]))
+        assert float(out[0, 0]) == 2.0 and np.isnan(np.asarray(out)[0, 1])
+
+    def test_errors(self):
+        with pytest.raises(ExprError):
+            compile_expr("")
+        with pytest.raises(ExprError):
+            compile_expr("sum(")
+        with pytest.raises(ExprError):
+            compile_expr("bogusfn(x)")
+
+
+class TestApply:
+    def test_sum_matches_numpy(self, api):
+        fares, _ = fill(api)
+        got = api.query("t", 'Apply("sum(fare)")')[0]
+        assert got.value == pytest.approx(sum(fares.values()), rel=1e-5)
+
+    def test_filtered_aggregation(self, api):
+        fares, _ = fill(api)
+        chosen = sorted(fares)[:50]
+        for c in chosen:
+            api.query("t", f"Set({c}, seg=1)")
+        got = api.query("t", 'Apply(Row(seg=1), "mean(fare)")')[0]
+        want = np.mean([fares[c] for c in chosen])
+        assert got.value == pytest.approx(want, rel=1e-5)
+
+    def test_compound_expression(self, api):
+        fares, dists = fill(api)
+        got = api.query("t", 'Apply("sum(fare + dist * 2)")')[0]
+        want = sum(fares[c] + dists[c] * 2 for c in fares if c in dists)
+        assert got.value == pytest.approx(want, rel=1e-5)
+
+    def test_vector_result(self, api):
+        api.import_dataframe("t", 0, [5, 9], {"fare": [10.0, 20.0]})
+        got = api.query("t", 'Apply("fare * 3")')[0]
+        assert got.value == [30.0, 60.0]
+
+    def test_count(self, api):
+        fill(api, n=123, shards=1)
+        got = api.query("t", 'Apply("count(fare)")')[0]
+        assert got.value == 123
+
+    def test_empty(self, api):
+        got = api.query("t", 'Apply("sum(fare)")')[0]
+        assert got.value == 0
+
+
+class TestArrow:
+    def test_extract_with_header(self, api):
+        api.import_dataframe("t", 0, [3, 7], {"fare": [1.5, 2.5],
+                                              "dist": [10, 20]})
+        api.import_dataframe("t", 1, [0], {"fare": [9.0]})
+        got = api.query("t", 'Arrow(header=["fare"])')[0]
+        assert [f.name for f in got.fields] == ["fare"]
+        assert got.ids == [3, 7, SHARD_WIDTH]
+        assert got.columns == [[1.5, 2.5, 9.0]]
+
+    def test_filtered_all_columns(self, api):
+        api.import_dataframe("t", 0, [3, 7], {"fare": [1.5, 2.5],
+                                              "dist": [10, 20]})
+        api.query("t", "Set(7, seg=1)")
+        got = api.query("t", "Arrow(Row(seg=1))")[0]
+        assert got.ids == [7]
+        by_name = dict(zip([f.name for f in got.fields], got.columns))
+        assert by_name == {"fare": [2.5], "dist": [20]}
+
+
+class TestDataframePersistence:
+    def test_changeset_survives_crash(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("t")
+        api.import_dataframe("t", 0, [1, 2], {"fare": [5.0, 6.0]})
+        del api
+        api2 = API(str(tmp_path))
+        got = api2.query("t", 'Apply("sum(fare)")')[0]
+        assert got.value == pytest.approx(11.0)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("t")
+        api.import_dataframe("t", 0, [1, 2], {"fare": [5.0, 6.0],
+                                              "n": [1, 2]})
+        api.save()
+        assert api.holder.index("t").wal.size == 0
+        del api
+        api2 = API(str(tmp_path))
+        assert api2.dataframe_schema("t") == [
+            {"name": "fare", "type": "float64"},
+            {"name": "n", "type": "int64"},
+        ]
+        got = api2.query("t", 'Apply("sum(fare + n)")')[0]
+        assert got.value == pytest.approx(14.0)
+
+    def test_http_endpoints(self, tmp_path):
+        import json
+        import urllib.request
+
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        api.create_index("t")
+        srv, _ = serve(api, port=0, background=True)
+        port = srv.server_address[1]
+
+        def req(method, path, body=None):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode() if body is not None else None,
+                method=method)
+            return json.loads(urllib.request.urlopen(r).read())
+
+        assert req("POST", "/index/t/dataframe/0",
+                   {"shard_ids": [1, 2], "columns": {"fare": [3.0, 4.0]}}
+                   )["success"]
+        assert req("GET", "/index/t/dataframe")["schema"] == [
+            {"name": "fare", "type": "float64"}]
+        got = req("GET", "/index/t/dataframe/0")
+        assert got["columns"]["fare"]["positions"] == [1, 2]
+        srv.shutdown()
+
+    def test_http_apply_query(self):
+        import json
+        import urllib.request
+
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        api.create_index("t")
+        api.import_dataframe("t", 0, [1], {"fare": [2.5]})
+        srv, _ = serve(api, port=0, background=True)
+        port = srv.server_address[1]
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/t/query",
+            data='Apply("sum(fare)")'.encode(), method="POST")
+        out = json.loads(urllib.request.urlopen(r).read())
+        assert out["results"][0] == pytest.approx(2.5)
+        srv.shutdown()

@@ -439,3 +439,61 @@ class TestBatchedRegressions:
             (("a", 1), ("b", 20)): (1, -3),
             (("a", 2), ("b", 10)): (1, 100),
         }
+
+
+class TestSortFieldValue:
+    def setup_data(self, e, h):
+        idx = h.create_index("s")
+        idx.create_field("v", FieldOptions(type=FieldType.INT))
+        idx.create_field("b", FieldOptions(type=FieldType.BOOL))
+        idx.create_field("f")
+        q(e, "s", "Set(1, v=30)Set(2, v=10)Set(3, v=20)Set(1, f=1)Set(3, f=1)")
+        q(e, "s", "Set(1, b=true)Set(2, b=false)")
+
+    def test_sort_asc_desc(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "s", "Sort(field=v)")[0]
+        assert r.columns == [2, 3, 1] and r.values == [10, 20, 30]
+        r = q(e, "s", "Sort(field=v, sort-desc=true)")[0]
+        assert r.columns == [1, 3, 2]
+
+    def test_sort_filtered_limit(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "s", "Sort(Row(f=1), field=v, limit=1)")[0]
+        assert r.columns == [3] and r.values == [20]
+
+    def test_sort_bool(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        r = q(e, "s", "Sort(field=b)")[0]
+        assert r.columns == [2, 1] and r.values == [False, True]
+
+    def test_sort_cross_shard(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        big = SHARD_WIDTH + 9
+        q(e, "s", f"Set({big}, v=15)")
+        r = q(e, "s", "Sort(field=v)")[0]
+        assert r.columns == [2, big, 3, 1]
+
+    def test_field_value(self, env):
+        h, e = env
+        self.setup_data(e, h)
+        assert q(e, "s", "FieldValue(field=v, column=3)")[0].val == 20
+        assert q(e, "s", "FieldValue(field=v, column=99)")[0].count == 0
+        assert q(e, "s", "FieldValue(field=b, column=1)")[0].val is True
+        assert q(e, "s", "FieldValue(field=b, column=2)")[0].val is False
+
+    def test_external_lookup_unconfigured(self, env):
+        h, e = env
+        h.create_index("s").create_field("f")
+        with pytest.raises(PQLError):
+            q(e, "s", 'ExternalLookup(query="select 1")')
+
+    def test_external_lookup_plugged(self, env):
+        h, e = env
+        h.create_index("s")
+        e.external_lookup = lambda query, write: {"echo": query}
+        assert q(e, "s", 'ExternalLookup(query="x")')[0] == {"echo": "x"}

@@ -237,3 +237,50 @@ class TestReviewRegressions:
         del api
         api2 = reopen(tmp_path)
         assert api2.query("i", "Count(All())")[0] == 2
+
+
+class TestTombstones:
+    def test_dataframe_delete_survives_reopen(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("t")
+        api.import_dataframe("t", 0, [1], {"fare": [5.0]})
+        api.delete_dataframe("t")
+        del api
+        api2 = reopen(tmp_path)
+        assert api2.query("t", 'Apply("sum(fare)")')[0].value == 0
+        assert api2.dataframe_schema("t") == []
+
+    def test_field_delete_recreate_no_resurrection(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Set(1, f=1)")
+        api.save()  # checkpoint writes npz for f
+        api.delete_field("i", "f")
+        api.create_field("i", "f")
+        api.query("i", "Set(9, f=2)")
+        del api
+        api2 = reopen(tmp_path)
+        assert api2.query("i", "Row(f=1)")[0].columns == []
+        assert api2.query("i", "Row(f=2)")[0].columns == [9]
+
+    def test_concurrent_writers_no_wal_corruption(self, tmp_path):
+        import threading
+
+        api = API(str(tmp_path))
+        api.create_index("i")
+        api.create_field("i", "f")
+
+        def worker(row):
+            for c in range(50):
+                api.query("i", f"Set({c}, f={row})")
+
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        del api
+        api2 = reopen(tmp_path)
+        for r in range(4):
+            assert api2.query("i", f"Count(Row(f={r}))")[0] == 50
