@@ -17,9 +17,12 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
 from pilosa_tpu.pql.executor import Executor
+from pilosa_tpu.obs import ExecutionRequestsAPI, get_tracer
+from pilosa_tpu.obs import metrics as M
 from pilosa_tpu.pql.result import result_to_json
 from pilosa_tpu.storage import save_holder_data
 from pilosa_tpu.storage.txn import TxFactory
+from pilosa_tpu.transaction import TransactionManager
 
 
 class API:
@@ -27,6 +30,10 @@ class API:
         self.holder = Holder(path, wal_sync=wal_sync)
         self.executor = Executor(self.holder)
         self.txf = TxFactory(self.holder)
+        # observability + ops (reference: tracker.go query history,
+        # transaction.go cluster transactions)
+        self.history = ExecutionRequestsAPI()
+        self.transactions = TransactionManager()
         self._sql_engine = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
@@ -39,10 +46,13 @@ class API:
             keys=bool((options or {}).get("keys", False)),
             track_existence=bool((options or {}).get("trackExistence", True)),
         )
-        return self.holder.create_index(name, opts)
+        idx = self.holder.create_index(name, opts)
+        M.REGISTRY.count(M.METRIC_CREATE_INDEX)
+        return idx
 
     def delete_index(self, name: str) -> None:
         self.holder.delete_index(name)
+        M.REGISTRY.count(M.METRIC_DELETE_INDEX)
 
     def create_field(self, index: str, field: str,
                      options: Optional[dict] = None) -> None:
@@ -62,11 +72,13 @@ class API:
             cache_size=int(o.pop("cacheSize", 50000)),
         )
         self.holder.index(index).create_field(field, fo)
+        M.REGISTRY.count(M.METRIC_CREATE_FIELD)
         self.holder.save_schema()
 
     def delete_field(self, index: str, field: str) -> None:
         with self.txf.qcx():  # flushes the delete_field WAL tombstone
             self.holder.index(index).delete_field(field)
+        M.REGISTRY.count(M.METRIC_DELETE_FIELD)
         self.holder.save_schema()
 
     def schema(self) -> List[dict]:
@@ -76,8 +88,20 @@ class API:
 
     def query(self, index: str, pql: str,
               shards: Optional[Sequence[int]] = None) -> List[Any]:
-        with self.txf.qcx():  # group-commits any write calls' WAL records
-            return self.executor.execute(index, pql, shards=shards)
+        M.REGISTRY.count(M.METRIC_PQL_QUERIES)
+        rec = self.history.begin(index, pql if isinstance(pql, str) else "",
+                                 "pql")
+        span = get_tracer().start_span("executor.Execute", index=index)
+        try:
+            with self.txf.qcx():  # group-commits any write calls' WAL records
+                out = self.executor.execute(index, pql, shards=shards)
+            self.history.end(rec)
+            return out
+        except Exception as e:
+            self.history.end(rec, error=str(e))
+            raise
+        finally:
+            span.finish()
 
     def sql(self, query: str):
         """Execute a SQL statement (reference: server/sql.go:17 execSQL).
@@ -88,7 +112,15 @@ class API:
             # benign if two threads race (same-state engines)
             from pilosa_tpu.sql import SQLEngine
             eng = self._sql_engine = SQLEngine(self)
-        return eng.query(query)
+        M.REGISTRY.count(M.METRIC_SQL_QUERIES)
+        rec = self.history.begin("", query, "sql")
+        try:
+            out = eng.query(query)
+            self.history.end(rec)
+            return out
+        except Exception as e:
+            self.history.end(rec, error=str(e))
+            raise
 
     def query_json(self, index: str, pql: str) -> dict:
         results = [result_to_json(r) for r in self.query(index, pql)]
@@ -120,6 +152,9 @@ class API:
             changed = fld.import_bits(rows, cols, clear=clear)
             if not clear and idx.options.track_existence:
                 idx.field("_exists").import_bits([0] * len(cols), cols)
+        M.REGISTRY.count(M.METRIC_CLEARED if clear else M.METRIC_IMPORTED,
+                         len(cols))
+        self._update_shard_gauge(idx)
         return changed
 
     def import_values(self, index: str, field: str,
@@ -142,6 +177,8 @@ class API:
             if idx.options.track_existence:
                 idx.field("_exists").import_bits(
                     [0] * len(cols), [int(c) for c in cols])
+        M.REGISTRY.count(M.METRIC_IMPORTED, len(cols))
+        self._update_shard_gauge(idx)
         return len(cols)
 
     def import_roaring(self, index: str, field: str, shard: int,
@@ -182,6 +219,10 @@ class API:
                 base = shard * SHARD_WIDTH
                 idx.field("_exists").import_bits(
                     [0] * len(all_cols), [base + c for c in sorted(all_cols)])
+
+    def _update_shard_gauge(self, idx: Index) -> None:
+        M.REGISTRY.gauge(M.METRIC_MAX_SHARD, max(idx.shards(), default=0),
+                         index=idx.name)
 
     # -- dataframe (reference: apply.go ingest + http_handler.go:506-509) --
 

@@ -196,6 +196,12 @@ class Holder:
         if op == "df_delete":  # tombstone: wipe changesets replayed so far
             idx.dataframe.delete(log=False)
             return
+        if op == "delete_view":  # TTL sweep tombstone (server/maintenance)
+            f = idx.fields.get(fname)
+            if f is not None:
+                f.views.pop(rec[2], None)
+                f._stacked_cache = {}
+            return
         if op == "delete_field":
             # tombstone: a field deleted (and possibly re-created) after
             # earlier records were logged — wipe what replay built so far

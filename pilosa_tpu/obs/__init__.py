@@ -1,0 +1,15 @@
+"""Observability: metrics, tracing, query history.
+
+Reference: metrics.go (prometheus registry, ~70 series), tracing/
+(Tracer/Span facade + nested query profiles), tracker.go + systemlayer/
+(query-history ring exposed as /query-history and SQL system tables).
+"""
+
+from pilosa_tpu.obs.history import ExecutionRecord, ExecutionRequestsAPI
+from pilosa_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from pilosa_tpu.obs.tracing import NopTracer, Span, Tracer, get_tracer, set_tracer
+
+__all__ = [
+    "REGISTRY", "MetricsRegistry", "Tracer", "NopTracer", "Span",
+    "get_tracer", "set_tracer", "ExecutionRecord", "ExecutionRequestsAPI",
+]

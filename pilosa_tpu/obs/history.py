@@ -1,0 +1,74 @@
+"""Query-history ring: every PQL/SQL request, newest first.
+
+Reference: tracker.go:191 + systemlayer/systemlayer.go — an in-memory
+ring of ExecutionRequests served at /query-history (http_handler.go:540)
+and as the ``fb_exec_requests`` SQL system table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ExecutionRecord:
+    request_id: str
+    index: str
+    query: str
+    language: str  # "pql" | "sql"
+    start_time: float
+    runtime_ns: int = 0
+    status: str = "running"
+    error: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "requestID": self.request_id,
+            "index": self.index,
+            "query": self.query,
+            "language": self.language,
+            "startTime": self.start_time,
+            "runtimeNs": self.runtime_ns,
+            "status": self.status,
+            "error": self.error,
+        }
+
+
+class ExecutionRequestsAPI:
+    """Fixed-capacity ring (reference: systemlayer.go 100-entry ring)."""
+
+    def __init__(self, capacity: int = 100):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: List[ExecutionRecord] = []
+
+    def begin(self, index: str, query: str, language: str) -> ExecutionRecord:
+        rec = ExecutionRecord(
+            request_id=str(uuid.uuid4()), index=index, query=query,
+            language=language, start_time=time.time())
+        with self._lock:
+            self._ring.append(rec)
+            if len(self._ring) > self.capacity:
+                self._ring.pop(0)
+        return rec
+
+    def end(self, rec: ExecutionRecord, error: Optional[str] = None) -> None:
+        with self._lock:  # readers copy under the same lock
+            rec.runtime_ns = int((time.time() - rec.start_time) * 1e9)
+            rec.error = error or ""
+            rec.status = "error" if error else "complete"
+
+    def list(self) -> List[ExecutionRecord]:
+        with self._lock:  # copies: no torn reads of in-flight records
+            return [dataclasses.replace(r) for r in reversed(self._ring)]
+
+    def get(self, request_id: str) -> Optional[ExecutionRecord]:
+        with self._lock:
+            for r in self._ring:
+                if r.request_id == request_id:
+                    return dataclasses.replace(r)
+        return None

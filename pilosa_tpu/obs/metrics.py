@@ -1,0 +1,147 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference: metrics.go — the rebuild emits the same series names
+(pql_queries_total, query_row_total, set_bit_total,
+http_request_duration_seconds, ...) so dashboards written against the
+reference keep working; served at /metrics (text) and /metrics.json
+(http_handler.go:495-497).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Series names mirrored from the reference (metrics.go:7-57).
+METRIC_CREATE_INDEX = "create_index_total"
+METRIC_DELETE_INDEX = "delete_index_total"
+METRIC_CREATE_FIELD = "create_field_total"
+METRIC_DELETE_FIELD = "delete_field_total"
+METRIC_SET_BIT = "set_bit_total"
+METRIC_CLEAR_BIT = "clear_bit_total"
+METRIC_IMPORTED = "imported_total"
+METRIC_CLEARED = "cleared_total"
+METRIC_PQL_QUERIES = "pql_queries_total"
+METRIC_SQL_QUERIES = "sql_queries_total"
+METRIC_MAX_SHARD = "maximum_shard"
+METRIC_HTTP_DURATION = "http_request_duration_seconds"
+METRIC_SNAPSHOT_DURATION = "snapshot_duration_seconds"
+METRIC_TXN_START = "transaction_start"
+METRIC_TXN_END = "transaction_end"
+METRIC_TXN_BLOCKED = "transaction_blocked"
+METRIC_EXCLUSIVE_TXN_REQUEST = "transaction_exclusive_request"
+METRIC_EXCLUSIVE_TXN_ACTIVE = "transaction_exclusive_active"
+METRIC_DELETE_DATAFRAME = "delete_dataframe"
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class MetricsRegistry:
+    """Thread-safe counters/gauges/summaries (a summary keeps _count and
+    _sum, enough for rate+mean dashboards; the reference's prometheus
+    client keeps quantiles we don't need for parity of names)."""
+
+    def __init__(self, namespace: str = "pilosa"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._counters: Dict[_Key, float] = {}
+        self._gauges: Dict[_Key, float] = {}
+        self._summaries: Dict[_Key, Tuple[int, float]] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[dict]) -> _Key:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def count(self, name: str, n: float = 1, **labels) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + n
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, seconds: float, **labels) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            c, s = self._summaries.get(k, (0, 0.0))
+            self._summaries[k] = (c + 1, s + seconds)
+
+    def timer(self, name: str, **labels):
+        """Context manager observing wall time into a summary."""
+        reg = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                reg.observe(name, time.perf_counter() - self.t0, **labels)
+
+        return _T()
+
+    def value(self, name: str, **labels) -> float:
+        """Counter or gauge value (a name is one kind — counters take
+        precedence if ever misused for both); for summaries use
+        ``summary()``."""
+        k = self._key(name, labels)
+        with self._lock:
+            if k in self._counters:
+                return self._counters[k]
+            return self._gauges.get(k, 0.0)
+
+    def summary(self, name: str, **labels) -> Tuple[int, float]:
+        """(observation count, seconds sum) of a summary series."""
+        with self._lock:
+            return self._summaries.get(self._key(name, labels), (0, 0.0))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._summaries.clear()
+
+    # -- exposition --------------------------------------------------------
+
+    def _fmt_labels(self, labels: Tuple[Tuple[str, str], ...]) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    def prometheus_text(self) -> str:
+        """Text exposition format (served at /metrics, reference:
+        http_handler.go:495)."""
+        out: List[str] = []
+        ns = self.namespace
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                out.append(f"# TYPE {ns}_{name} counter")
+                out.append(f"{ns}_{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {ns}_{name} gauge")
+                out.append(f"{ns}_{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), (c, s) in sorted(self._summaries.items()):
+                out.append(f"# TYPE {ns}_{name} summary")
+                lbl = self._fmt_labels(labels)
+                out.append(f"{ns}_{name}_count{lbl} {c}")
+                out.append(f"{ns}_{name}_sum{lbl} {s}")
+        return "\n".join(out) + "\n"
+
+    def as_json(self) -> dict:
+        with self._lock:
+            def enc(d):
+                return {f"{n}{self._fmt_labels(l)}": v for (n, l), v in d.items()}
+            return {
+                "counters": enc(self._counters),
+                "gauges": enc(self._gauges),
+                "summaries": {
+                    f"{n}{self._fmt_labels(l)}": {"count": c, "sum": s}
+                    for (n, l), (c, s) in self._summaries.items()
+                },
+            }
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,111 @@
+"""Tracing facade: spans, a global tracer, and per-query profiles.
+
+Reference: tracing/tracing.go — ``Tracer``/``Span`` interfaces with a
+swappable global tracer (:12-73), and ``ProfiledSpan`` trees returned with
+query results when profiling is on (:22-53). The OpenTracing/Jaeger
+binding becomes a plug point here (set_tracer with any compatible
+implementation); the built-in tracer records in-process span trees, which
+is also what the per-query profile uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Span:
+    __slots__ = ("name", "start", "duration_s", "tags", "children", "_tracer")
+
+    def __init__(self, name: str, tracer: "Tracer"):
+        self.name = name
+        self.start = time.time()
+        self.duration_s: Optional[float] = None
+        self.tags: Dict[str, Any] = {}
+        self.children: List["Span"] = []
+        self._tracer = tracer
+
+    def set_tag(self, key: str, value: Any) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def finish(self) -> None:
+        if self.duration_s is None:
+            self.duration_s = time.time() - self.start
+            self._tracer._pop(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_ns": int((self.duration_s or 0) * 1e9),
+            "tags": self.tags,
+            "children": [c.to_json() for c in self.children],
+        }
+
+
+class Tracer:
+    """In-process tracer building span trees per thread (the profile
+    collector; reference: ProfiledSpan tracing/tracing.go:22)."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def start_span(self, name: str, **tags) -> Span:
+        span = Span(name, self)
+        span.tags.update(tags)
+        st = self._stack()
+        if st:
+            st[-1].children.append(span)
+        st.append(span)
+        return span
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        while st and st[-1] is not span:
+            st.pop()
+        if st:
+            st.pop()
+
+    def profile(self, name: str):
+        """Start a root profile span; caller keeps the Span and reads
+        .to_json() after finish (the per-query profile)."""
+        return self.start_span(name)
+
+
+class NopTracer(Tracer):
+    """No-op spans for hot paths when tracing is off."""
+
+    _NOP = None
+
+    def start_span(self, name: str, **tags) -> Span:
+        span = Span(name, self)
+        return span
+
+    def _pop(self, span: Span) -> None:
+        pass
+
+
+_global = NopTracer()
+
+
+def get_tracer() -> Tracer:
+    return _global
+
+
+def set_tracer(t: Tracer) -> None:
+    """Swap the global tracer (reference: tracing.RegisterTracer)."""
+    global _global
+    _global = t

@@ -63,6 +63,17 @@ _ROUTES = [
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/info$"), "get_info"),
+    # observability (reference: http_handler.go:495-497, :540)
+    ("GET", re.compile(r"^/metrics$"), "get_metrics"),
+    ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
+    ("GET", re.compile(r"^/query-history$"), "get_query_history"),
+    ("GET", re.compile(r"^/index/([^/]+)/mutex-check$"), "get_mutex_check"),
+    # cluster transactions (reference: http_handler.go:528-533)
+    ("POST", re.compile(r"^/transaction/?$"), "post_transaction"),
+    ("GET", re.compile(r"^/transaction/([^/]+)$"), "get_transaction"),
+    ("POST", re.compile(r"^/transaction/([^/]+)/finish$"),
+     "post_transaction_finish"),
+    ("GET", re.compile(r"^/transactions$"), "get_transactions"),
 ]
 
 
@@ -104,13 +115,17 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _dispatch(self, method: str) -> None:
+        from pilosa_tpu.obs.metrics import METRIC_HTTP_DURATION, REGISTRY
+
         for m, pattern, name in _ROUTES:
             if m != method:
                 continue
             match = pattern.match(self.path.split("?", 1)[0])
             if match:
                 try:
-                    getattr(self, name)(*match.groups())
+                    with REGISTRY.timer(METRIC_HTTP_DURATION,
+                                        method=method, route=name):
+                        getattr(self, name)(*match.groups())
                 except KeyError as e:
                     self._send(404, {"error": str(e)})
                 except (ValueError, json.JSONDecodeError) as e:
@@ -222,6 +237,68 @@ class Handler(BaseHTTPRequestHandler):
         )
         self._send(200, {"imported": n})
 
+    def get_metrics(self):
+        from pilosa_tpu.obs.metrics import REGISTRY
+
+        body = REGISTRY.prometheus_text().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def get_metrics_json(self):
+        from pilosa_tpu.obs.metrics import REGISTRY
+
+        self._send(200, REGISTRY.as_json())
+
+    def get_query_history(self):
+        self._send(200, [r.to_json() for r in self.api.history.list()])
+
+    def get_mutex_check(self, index: str):
+        from pilosa_tpu.server.maintenance import mutex_check
+
+        out = mutex_check(self.api.holder, index)
+        self._send(200, {f: {str(c): rows for c, rows in bad.items()}
+                         for f, bad in out.items()})
+
+    def post_transaction(self):
+        from pilosa_tpu.transaction import TransactionError
+
+        b = self._json_body()
+        try:
+            tx = self.api.transactions.start(
+                tid=b.get("id"), timeout_s=b.get("timeout"),
+                exclusive=bool(b.get("exclusive", False)))
+        except TransactionError as e:
+            self._send(409, {"error": str(e)})
+            return
+        self._send(200, {"transaction": tx.to_json()})
+
+    def get_transaction(self, tid: str):
+        from pilosa_tpu.transaction import TransactionError
+
+        try:
+            tx = self.api.transactions.get(tid)
+        except TransactionError as e:
+            self._send(404, {"error": str(e)})
+            return
+        self._send(200, {"transaction": tx.to_json()})
+
+    def post_transaction_finish(self, tid: str):
+        from pilosa_tpu.transaction import TransactionError
+
+        try:
+            tx = self.api.transactions.finish(tid)
+        except TransactionError as e:
+            self._send(404, {"error": str(e)})
+            return
+        self._send(200, {"transaction": tx.to_json()})
+
+    def get_transactions(self):
+        self._send(200, {"transactions": [
+            t.to_json() for t in self.api.transactions.list()]})
+
     def get_schema(self):
         self._send(200, {"indexes": self.api.schema()})
 
@@ -288,12 +365,35 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(api: API, host: str = "127.0.0.1", port: int = 10101,
-          background: bool = False) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+          background: bool = False, maintenance_interval_s: Optional[float] = None
+          ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
     """Start the HTTP server (reference: server.go:618 Open + listener).
     With background=True returns (server, thread) for in-process use —
-    the test harness pattern (reference: test/cluster.go)."""
+    the test harness pattern (reference: test/cluster.go). A maintenance
+    interval starts the TTL view-removal loop (reference: server.go:902
+    ViewsRemoval ticker)."""
     handler = type("BoundHandler", (Handler,), {"api": api})
-    srv = ThreadingHTTPServer((host, port), handler)
+
+    class _Server(ThreadingHTTPServer):
+        maintenance_loop = None
+
+        def server_close(self):  # stop the sweep with the listener
+            if self.maintenance_loop is not None:
+                self.maintenance_loop.stop()
+            super().server_close()
+
+        def shutdown(self):
+            if self.maintenance_loop is not None:
+                self.maintenance_loop.stop()
+            super().shutdown()
+
+    srv = _Server((host, port), handler)
+    if maintenance_interval_s:
+        from pilosa_tpu.server.maintenance import MaintenanceLoop
+
+        loop = MaintenanceLoop(api.holder, interval_s=maintenance_interval_s)
+        loop.start()
+        srv.maintenance_loop = loop
     if background:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()

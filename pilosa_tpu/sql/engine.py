@@ -64,6 +64,8 @@ class SQLEngine:
 
     def _dispatch(self, stmt) -> SQLResult:
         if isinstance(stmt, ast.SelectStatement):
+            if stmt.table in _SYSTEM_TABLES:
+                return self._system_table(stmt)
             op = self.planner.plan_select(stmt)
             return SQLResult(schema=op.schema, data=[list(r) for r in op.rows()])
         if isinstance(stmt, ast.CreateTable):
@@ -232,6 +234,36 @@ class SQLEngine:
 
     # -- SHOW -----------------------------------------------------------------
 
+    # -- system tables (reference: systemlayer/systemlayer.go exposing the
+    #    query-history ring as fb_exec_requests) ------------------------------
+
+    def _system_table(self, stmt: ast.SelectStatement) -> SQLResult:
+        if (stmt.where is not None or stmt.order_by or stmt.group_by
+                or stmt.distinct or stmt.offset):
+            # refuse rather than silently return unfiltered rows
+            raise SQLError(
+                "system tables support only SELECT <cols> [LIMIT n]")
+        cols, provider = _SYSTEM_TABLES[stmt.table]
+        rows = provider(self.api)
+        names = [c[0] for c in cols]
+        want = names
+        if not (len(stmt.items) == 1
+                and isinstance(stmt.items[0].expr, ast.Star)):
+            want = []
+            for it in stmt.items:
+                if not isinstance(it.expr, ast.ColumnRef):
+                    raise SQLError(
+                        "system tables support only plain column selects")
+                if it.expr.name not in names:
+                    raise SQLError(f"unknown column {it.expr.name!r}")
+                want.append(it.expr.name)
+        sel = [names.index(w) for w in want]
+        data = [[r[i] for i in sel] for r in rows]
+        if stmt.limit is not None:
+            data = data[: stmt.limit]
+        schema = [cols[i] for i in sel]
+        return SQLResult(schema=schema, data=data)
+
     def _show_tables(self) -> SQLResult:
         rows = [[name] for name in sorted(self.api.holder.indexes)]
         return SQLResult(schema=[("name", "STRING")], data=rows)
@@ -243,6 +275,35 @@ class SQLEngine:
             rows.append([f.name, field_to_sql_type(f.options)])
         return SQLResult(schema=[("name", "STRING"), ("type", "STRING")],
                          data=rows)
+
+
+def _exec_requests_rows(api) -> List[List[Any]]:
+    return [[r.request_id, r.index, r.query, r.language, r.start_time,
+             r.runtime_ns, r.status, r.error]
+            for r in api.history.list()]
+
+
+def _performance_counters_rows(api) -> List[List[Any]]:
+    from pilosa_tpu.obs.metrics import REGISTRY
+
+    j = REGISTRY.as_json()
+    rows = [[k, float(v)] for k, v in j["counters"].items()]
+    rows += [[k, float(v)] for k, v in j["gauges"].items()]
+    return sorted(rows)
+
+
+# name -> (schema, provider(api) -> rows); reference: fb_exec_requests et
+# al in systemlayer/ + sql3 system tables
+_SYSTEM_TABLES = {
+    "fb_exec_requests": (
+        [("request_id", "STRING"), ("index", "STRING"), ("query", "STRING"),
+         ("language", "STRING"), ("start_time", "DECIMAL"),
+         ("runtime_ns", "INT"), ("status", "STRING"), ("error", "STRING")],
+        _exec_requests_rows),
+    "fb_performance_counters": (
+        [("name", "STRING"), ("value", "DECIMAL")],
+        _performance_counters_rows),
+}
 
 
 def _coerce(raw: str, typ: str):

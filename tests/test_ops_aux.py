@@ -1,0 +1,234 @@
+"""Observability + ops subsystems: metrics, tracing, query history,
+cluster transactions, TTL view removal, mutex check.
+
+Reference analogs: metrics.go names, tracing/tracing.go span trees,
+tracker.go history ring, transaction.go exclusive semantics
+(transaction_test.go), server.go ViewsRemoval, view.go:449 mutexCheck.
+"""
+
+import datetime as dt
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.obs.metrics import MetricsRegistry, REGISTRY
+from pilosa_tpu.obs.tracing import Tracer
+from pilosa_tpu.server.maintenance import mutex_check, remove_expired_views
+from pilosa_tpu.transaction import TransactionError, TransactionManager
+
+
+class TestMetrics:
+    def test_counters_gauges_summaries(self):
+        r = MetricsRegistry()
+        r.count("pql_queries_total")
+        r.count("pql_queries_total", 2)
+        r.gauge("maximum_shard", 5, index="i")
+        r.observe("http_request_duration_seconds", 0.25, route="q")
+        assert r.value("pql_queries_total") == 3
+        text = r.prometheus_text()
+        assert "pilosa_pql_queries_total 3" in text
+        assert 'pilosa_maximum_shard{index="i"} 5' in text
+        assert 'pilosa_http_request_duration_seconds_count{route="q"} 1' in text
+
+    def test_api_instruments(self):
+        base = REGISTRY.value("pql_queries_total")
+        api = API()
+        api.create_index("m")
+        api.create_field("m", "f")
+        api.query("m", "Set(1, f=1)")
+        api.import_bits("m", "f", rows=[1], cols=[2])
+        assert REGISTRY.value("pql_queries_total") == base + 1
+        assert REGISTRY.value("imported_total") >= 1
+        assert REGISTRY.value("maximum_shard", index="m") == 0
+
+
+class TestTracing:
+    def test_span_tree(self):
+        t = Tracer()
+        with t.start_span("root") as root:
+            with t.start_span("child", shard=3):
+                pass
+            with t.start_span("child2"):
+                pass
+        j = root.to_json()
+        assert j["name"] == "root"
+        assert [c["name"] for c in j["children"]] == ["child", "child2"]
+        assert j["children"][0]["tags"] == {"shard": 3}
+        assert j["duration_ns"] > 0
+
+
+class TestHistory:
+    def test_ring_records_pql_and_sql(self):
+        api = API()
+        api.create_index("h")
+        api.create_field("h", "f")
+        api.query("h", "Count(Row(f=1))")
+        api.sql("show tables")
+        hist = api.history.list()
+        assert hist[0].language == "sql" and hist[0].status == "complete"
+        assert hist[1].language == "pql" and hist[1].query == "Count(Row(f=1))"
+        with pytest.raises(Exception):
+            api.query("h", "Bogus()")
+        assert api.history.list()[0].status == "error"
+
+    def test_sql_system_tables(self):
+        api = API()
+        api.create_index("h")
+        api.create_field("h", "f")
+        api.query("h", "Count(Row(f=1))")
+        res = api.sql("select query, status from fb_exec_requests")
+        assert ["Count(Row(f=1))", "complete"] in res.data
+        res = api.sql("select * from fb_performance_counters")
+        assert any(row[0].startswith("pql_queries_total") for row in res.data)
+
+
+class TestTransactions:
+    def test_exclusive_blocks_others(self):
+        tm = TransactionManager()
+        t1 = tm.start("a")
+        assert t1.active and not t1.exclusive
+        tex = tm.start("x", exclusive=True)
+        assert not tex.active  # pending until alone
+        with pytest.raises(TransactionError):
+            tm.start("b")  # blocked while exclusive exists
+        tm.finish("a")
+        assert tm.get("x").active  # activated once alone
+        assert tm.exclusive_active()
+        tm.finish("x")
+        assert tm.list() == []
+
+    def test_deadline_expiry(self):
+        tm = TransactionManager()
+        tm.start("t", timeout_s=-1)  # already expired
+        with pytest.raises(TransactionError):
+            tm.get("t")
+
+    def test_http_endpoints(self):
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        srv, _ = serve(api, port=0, background=True)
+        port = srv.server_address[1]
+
+        def req(method, path, body=None):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode() if body is not None else None,
+                method=method)
+            return json.loads(urllib.request.urlopen(r).read())
+
+        tx = req("POST", "/transaction", {"id": "backup", "exclusive": True})
+        assert tx["transaction"]["active"]
+        got = req("GET", "/transaction/backup")
+        assert got["transaction"]["exclusive"]
+        assert len(req("GET", "/transactions")["transactions"]) == 1
+        req("POST", "/transaction/backup/finish")
+        assert req("GET", "/transactions")["transactions"] == []
+        # metrics + history endpoints
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "pilosa_transaction_start" in text
+        assert isinstance(req("GET", "/query-history"), list)
+        srv.shutdown()
+
+
+class TestTTLRemoval:
+    def test_expired_views_removed(self):
+        api = API()
+        api.create_index("t")
+        api.create_field("t", "ev", {"type": "time", "timeQuantum": "YMD",
+                                     "ttl": 30 * 86400})
+        api.query("t", "Set(1, ev=5, 2020-01-02T00:00)")
+        api.query("t", "Set(2, ev=5, 2099-06-01T00:00)")
+        field = api.holder.index("t").field("ev")
+        before = set(field.views)
+        removed = remove_expired_views(api.holder,
+                                       now=dt.datetime(2099, 6, 2))
+        assert any("standard_2020" in r for r in removed)
+        assert all("standard_2099" not in r for r in removed)
+        # standard view unaffected; recent views kept
+        assert "standard" in field.views
+        assert any(v.startswith("standard_2099") for v in field.views)
+        assert set(field.views) < before
+
+    def test_no_ttl_untouched(self):
+        api = API()
+        api.create_index("t")
+        api.create_field("t", "ev", {"type": "time", "timeQuantum": "YMD"})
+        api.query("t", "Set(1, ev=5, 2020-01-02T00:00)")
+        assert remove_expired_views(api.holder,
+                                    now=dt.datetime(2099, 1, 1)) == []
+
+
+class TestMutexCheck:
+    def test_detects_violation(self):
+        api = API()
+        api.create_index("m")
+        api.create_field("m", "mx", {"type": "mutex"})
+        api.query("m", "Set(5, mx=1)")
+        # violate the invariant behind the field API's back
+        field = api.holder.index("m").field("mx")
+        frag = field.fragment(0)
+        frag.set_bit(frag.row_ids[0] + 1 if 2 not in frag.row_index else 3, 5)
+        out = mutex_check(api.holder, "m")
+        assert "mx" in out and 5 in out["mx"] and len(out["mx"][5]) == 2
+
+    def test_clean(self):
+        api = API()
+        api.create_index("m")
+        api.create_field("m", "mx", {"type": "mutex"})
+        api.query("m", "Set(5, mx=1)Set(5, mx=2)")  # mutex replaces
+        assert mutex_check(api.holder, "m") == {}
+
+
+class TestOpsReviewRegressions:
+    def test_system_table_rejects_where(self):
+        api = API()
+        from pilosa_tpu.sql.lexer import SQLError
+        with pytest.raises(SQLError):
+            api.sql("select query from fb_exec_requests where status = 'x'")
+
+    def test_pending_exclusive_activates_on_expiry(self):
+        tm = TransactionManager()
+        tm.start("a", timeout_s=0.05)
+        tex = tm.start("x", exclusive=True)
+        assert not tex.active
+        import time
+        time.sleep(0.06)
+        assert tm.get("x").active  # blocker expired -> activated
+        tm.finish("x")
+
+    def test_pending_exclusive_expires(self):
+        tm = TransactionManager()
+        tm.start("a", timeout_s=100)
+        tm.start("x", exclusive=True, timeout_s=0.05)
+        import time
+        time.sleep(0.06)
+        tm.finish("a")
+        with pytest.raises(TransactionError):
+            tm.get("x")  # expired while pending, not deadlocked
+        tm.start("b")  # manager usable again
+
+    def test_ttl_removal_survives_restart(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("t")
+        api.create_field("t", "ev", {"type": "time", "timeQuantum": "YMD",
+                                     "ttl": 86400})
+        api.query("t", "Set(1, ev=5, 2020-01-02T00:00)")
+        api.save()  # checkpoint writes the 2020 view's npz files
+        removed = remove_expired_views(api.holder,
+                                       now=dt.datetime(2099, 1, 1))
+        assert removed
+        del api
+        api2 = API(str(tmp_path))
+        field = api2.holder.index("t").field("ev")
+        assert not any(v.startswith("standard_2020") for v in field.views)
+
+    def test_metrics_summary_accessor(self):
+        from pilosa_tpu.obs.metrics import MetricsRegistry
+        r = MetricsRegistry()
+        r.observe("x_seconds", 0.5)
+        r.observe("x_seconds", 1.5)
+        assert r.summary("x_seconds") == (2, 2.0)
