@@ -1,0 +1,9 @@
+"""``python -m pilosa_tpu`` — the CLI entry point (reference:
+cmd/featurebase/main.go:16)."""
+
+import sys
+
+from pilosa_tpu.ctl import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -258,6 +258,120 @@ class API:
         with self.txf.qcx():  # flushes the df_delete WAL tombstone
             self.holder.index(index).dataframe.delete()
 
+    # -- backup / restore / checksum (reference: ctl/backup.go,
+    #    ctl/backup_tar.go, ctl/restore.go, ctl/chksum.go) ------------------
+
+    def backup_tar(self, fileobj) -> None:
+        """Stream a tar snapshot: schema + fragments + BSI + dataframe +
+        translate journals. Consistent under the write lock (the
+        reference holds a cluster transaction instead,
+        ctl/backup.go:30)."""
+        import tarfile
+        import tempfile
+
+        from pilosa_tpu.storage.store import export_holder
+
+        with self.holder.write_lock:
+            with tempfile.TemporaryDirectory(prefix="pilosa-backup") as tmp:
+                export_holder(self.holder, tmp)
+                with tarfile.open(fileobj=fileobj, mode="w|gz") as tar:
+                    tar.add(tmp, arcname=".")
+
+    def restore_tar(self, fileobj) -> None:
+        """Replace ALL holder contents with a backup_tar snapshot
+        (reference: ctl/restore.go)."""
+        import tarfile
+        import tempfile
+
+        from pilosa_tpu.core.schema import IndexOptions as IO
+
+        with tempfile.TemporaryDirectory(prefix="pilosa-restore") as tmp:
+            with tarfile.open(fileobj=fileobj, mode="r|*") as tar:
+                tar.extractall(tmp, filter="data")
+            with self.holder.write_lock:
+                for name in list(self.holder.indexes):
+                    self.holder.delete_index(name)
+                src = Holder(tmp)
+                src.recover()
+                # rebuild through our own holder so WALs/paths attach to
+                # THIS server's data dir, then copy the loaded planes over
+                for sidx in src.indexes.values():
+                    didx = self.holder.create_index(sidx.name, sidx.options)
+                    for f in sidx.public_fields():
+                        didx.create_field(f.name, f.options)
+                    for fname, sf in sidx.fields.items():
+                        df_ = didx.fields[fname]
+                        for view, frags in sf.views.items():
+                            for shard, frag in frags.items():
+                                for slot, row in enumerate(frag.row_ids):
+                                    df_.write_row_plane(
+                                        shard, row, frag.planes[slot],
+                                        clear=True, view=view)
+                        # BSI planes are copied directly (not WAL-logged);
+                        # the checkpoint below persists them
+                        for shard, bfrag in sf.bsi.items():
+                            b = df_.bsi_fragment(shard, create=True)
+                            b._ensure_depth(bfrag.depth)
+                            b.planes[: bfrag.planes.shape[0]] = bfrag.planes
+                            b.version += 1
+                        if sf.translate is not None and df_.translate is not None:
+                            # rewrites the journal so the mapping survives
+                            # the next reopen
+                            df_.translate.replace_all(sf.translate.key_to_id)
+                    if sidx.translate is not None and didx.translate is not None:
+                        didx.translate.replace_all(sidx.translate.key_to_id)
+                    for shard, frame in sidx.dataframe.frames.items():
+                        didx.dataframe.frames[shard] = frame
+                        frame.version += 1
+                self.holder.save_schema()
+            if self.holder.path:
+                # make the restore durable immediately (BSI planes above
+                # are not WAL-logged; the checkpoint persists them)
+                self.holder.checkpoint()
+
+    def checksum(self) -> str:
+        """Deterministic digest of all data — compare across replicas
+        (reference: ctl/chksum.go cluster checksum)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        with self.holder.write_lock:
+            import json as _json
+
+            h.update(_json.dumps(self.holder.schema(),
+                                 sort_keys=True).encode())
+            for iname in sorted(self.holder.indexes):
+                idx = self.holder.indexes[iname]
+                for fname in sorted(idx.fields):
+                    field = idx.fields[fname]
+                    for view in sorted(field.views):
+                        for shard in sorted(field.views[view]):
+                            frag = field.views[view][shard]
+                            h.update(f"{iname}/{fname}/{view}/{shard}".encode())
+                            n = len(frag.row_ids)
+                            h.update(np.asarray(frag.row_ids,
+                                                dtype=np.uint64).tobytes())
+                            h.update(np.ascontiguousarray(
+                                frag.planes[:n]).tobytes())
+                    for shard in sorted(field.bsi):
+                        h.update(f"{iname}/{fname}/bsi/{shard}".encode())
+                        h.update(np.ascontiguousarray(
+                            field.bsi[shard].planes).tobytes())
+                    if field.translate is not None:
+                        h.update(_json.dumps(
+                            sorted(field.translate.key_to_id.items())).encode())
+                if idx.translate is not None:
+                    h.update(_json.dumps(
+                        sorted(idx.translate.key_to_id.items())).encode())
+                for shard in sorted(idx.dataframe.frames):
+                    frame = idx.dataframe.frames[shard]
+                    for name in sorted(frame.columns):
+                        h.update(f"df/{iname}/{shard}/{name}".encode())
+                        h.update(np.ascontiguousarray(
+                            frame.columns[name]).tobytes())
+                        h.update(np.packbits(frame.valid[name]).tobytes())
+        return h.hexdigest()
+
     # -- persistence (reference: backup/restore ctl/backup.go) -------------
 
     def save(self) -> None:

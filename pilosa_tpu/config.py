@@ -1,0 +1,113 @@
+"""Server configuration: defaults <- TOML <- env <- CLI flags.
+
+Reference: server/config.go:51 (~100-field Config bound through
+viper/pflag with PILOSA_* env, ctl/server.go:160 BuildServerFlags,
+``featurebase generate-config``). Same layering here with the stdlib:
+tomllib for files, PILOSA_TPU_* env vars, argparse flags — last source
+wins per field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+_ENV_PREFIX = "PILOSA_TPU_"
+
+
+@dataclasses.dataclass
+class Config:
+    # listener
+    bind: str = "127.0.0.1"
+    port: int = 10101
+    # storage
+    data_dir: str = ""
+    wal_sync: str = "batch"  # always | batch | never
+    checkpoint_bytes: int = 64 << 20
+    # cluster (reference: etcd/cluster sections)
+    name: str = "pilosa-tpu"
+    node_id: str = ""
+    peers: List[str] = dataclasses.field(default_factory=list)
+    replicas: int = 1
+    # maintenance
+    ttl_removal_interval_s: float = 3600.0
+    # auth (reference: auth section)
+    auth_enable: bool = False
+    auth_secret: str = ""
+    auth_permissions_file: str = ""
+    auth_allowed_networks: List[str] = dataclasses.field(default_factory=list)
+    # observability
+    tracing_enable: bool = False
+    # dataframe (reference: --dataframe.enable; on by default here)
+    dataframe_enable: bool = True
+
+    # -- sources -----------------------------------------------------------
+
+    @classmethod
+    def from_sources(cls, toml_path: Optional[str] = None,
+                     env: Optional[Dict[str, str]] = None,
+                     flags: Optional[Dict[str, Any]] = None) -> "Config":
+        cfg = cls()
+        if toml_path:
+            cfg._apply(cls._load_toml(toml_path))
+        cfg._apply(cls._from_env(env if env is not None else os.environ))
+        if flags:
+            cfg._apply({k: v for k, v in flags.items() if v is not None})
+        return cfg
+
+    def _apply(self, values: Dict[str, Any]) -> None:
+        for f in dataclasses.fields(self):
+            if f.name not in values:
+                continue
+            v = values[f.name]
+            if f.type in ("int", int):
+                v = int(v)
+            elif f.type in ("float", float):
+                v = float(v)
+            elif f.type in ("bool", bool) and isinstance(v, str):
+                v = v.strip().lower() in ("1", "true", "t", "yes")
+            elif "List" in str(f.type) and isinstance(v, str):
+                v = [p for p in v.split(",") if p]
+            setattr(self, f.name, v)
+
+    @staticmethod
+    def _load_toml(path: str) -> Dict[str, Any]:
+        import tomllib
+
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        flat: Dict[str, Any] = {}
+        for k, v in doc.items():
+            if isinstance(v, dict):  # [section] key -> section_key
+                for k2, v2 in v.items():
+                    flat[f"{k}_{k2}".replace("-", "_")] = v2
+            else:
+                flat[k.replace("-", "_")] = v
+        return flat
+
+    @classmethod
+    def _from_env(cls, env) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(cls):
+            key = _ENV_PREFIX + f.name.upper()
+            if key in env:
+                out[f.name] = env[key]
+        return out
+
+    # -- generate-config (reference: ctl/generate_config.go) ---------------
+
+    def to_toml(self) -> str:
+        lines = ["# pilosa-tpu configuration (all keys optional)"]
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, bool):
+                tv = "true" if v else "false"
+            elif isinstance(v, (int, float)):
+                tv = str(v)
+            elif isinstance(v, list):
+                tv = "[" + ", ".join(f'"{x}"' for x in v) + "]"
+            else:
+                tv = f'"{v}"'
+            lines.append(f"{f.name.replace('_', '-')} = {tv}")
+        return "\n".join(lines) + "\n"

@@ -79,6 +79,22 @@ class TranslateStore:
     def translate_ids(self, ids: Iterable[int]) -> Dict[int, str]:
         return {i: self.id_to_key[i] for i in ids if i in self.id_to_key}
 
+    def replace_all(self, key_to_id: Dict[str, int]) -> None:
+        """Replace the whole mapping AND rewrite the journal — the restore
+        path (reference: restore writes translate partitions wholesale,
+        ctl/restore.go)."""
+        with self._lock:
+            self.key_to_id = dict(key_to_id)
+            self.id_to_key = {i: k for k, i in key_to_id.items()}
+            self._next = max([i + 1 for i in key_to_id.values()]
+                             + [self._start])
+            if self._path:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                with open(self._path, "w") as f:
+                    for key, id_ in sorted(key_to_id.items(),
+                                           key=lambda kv: kv[1]):
+                        f.write(json.dumps([key, id_]) + "\n")
+
     def __len__(self) -> int:
         return len(self.key_to_id)
 
@@ -175,6 +191,22 @@ class PartitionedTranslateStore:
 
     def translate_ids(self, ids: Iterable[int]) -> Dict[int, str]:
         return {i: self.id_to_key[i] for i in ids if i in self.id_to_key}
+
+    def replace_all(self, key_to_id: Dict[str, int]) -> None:
+        """Replace the whole mapping AND rewrite the journal (restore)."""
+        with self._lock:
+            self.key_to_id = dict(key_to_id)
+            self.id_to_key = {i: k for k, i in key_to_id.items()}
+            self._max_id = {}
+            for k, id_ in key_to_id.items():
+                p = self.partition(k)
+                self._max_id[p] = max(self._max_id.get(p, 0), id_)
+            if self._path:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                with open(self._path, "w") as f:
+                    for key, id_ in sorted(key_to_id.items(),
+                                           key=lambda kv: kv[1]):
+                        f.write(json.dumps([key, id_]) + "\n")
 
     def __len__(self) -> int:
         return len(self.key_to_id)

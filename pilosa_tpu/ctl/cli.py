@@ -1,0 +1,192 @@
+"""The ``pilosa-tpu`` command-line interface.
+
+Reference: cmd/root.go:50 cobra dispatch over ctl/ implementations:
+``server`` (ctl/server.go), ``backup``/``restore`` (ctl/backup.go,
+restore.go), ``import``/``export`` (ctl/import.go, export.go), ``chksum``
+(ctl/chksum.go), ``generate-config`` (ctl/generate_config.go), plus the
+``fbsql`` shell (cli/cli.go) as a subcommand here.
+
+Run as ``python -m pilosa_tpu <subcommand>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import io
+import sys
+import urllib.request
+from typing import List, Optional
+
+from pilosa_tpu.config import Config
+
+
+def _http(host: str, method: str, path: str, body: Optional[bytes] = None,
+          headers: Optional[dict] = None):
+    req = urllib.request.Request(host.rstrip("/") + path, data=body,
+                                 method=method, headers=headers or {})
+    return urllib.request.urlopen(req)
+
+
+def cmd_server(args) -> int:
+    cfg = Config.from_sources(toml_path=args.config, flags={
+        "bind": args.bind, "port": args.port, "data_dir": args.data_dir,
+        "wal_sync": args.wal_sync,
+    })
+    from pilosa_tpu.api import API
+    from pilosa_tpu.server.http import serve
+
+    api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync)
+    api.holder.checkpoint_bytes = cfg.checkpoint_bytes
+    print(f"pilosa-tpu serving on {cfg.bind}:{cfg.port} "
+          f"(data-dir={cfg.data_dir or '<memory>'})", file=sys.stderr)
+    serve(api, host=cfg.bind, port=cfg.port,
+          maintenance_interval_s=cfg.ttl_removal_interval_s)
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    sys.stdout.write(Config().to_toml())
+    return 0
+
+
+def cmd_backup(args) -> int:
+    with _http(args.host, "GET", "/internal/backup.tar") as resp, \
+            open(args.output, "wb") as f:
+        while True:
+            chunk = resp.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    print(f"backup written to {args.output}", file=sys.stderr)
+    return 0
+
+
+def cmd_restore(args) -> int:
+    with open(args.source, "rb") as f:
+        data = f.read()
+    _http(args.host, "POST", "/internal/restore", body=data)
+    print(f"restored {args.source} to {args.host}", file=sys.stderr)
+    return 0
+
+
+def cmd_chksum(args) -> int:
+    import json
+
+    with _http(args.host, "GET", "/internal/chksum") as resp:
+        print(json.loads(resp.read())["checksum"])
+    return 0
+
+
+def cmd_import(args) -> int:
+    """CSV import (reference: ctl/import.go): set fields take
+    ``row,col`` lines; int fields (--field-type int) take ``col,value``;
+    --keys treats both columns as string keys."""
+    import json
+
+    rows: List = []
+    cols: List = []
+    with open(args.file, newline="") as f:
+        for line in csv.reader(f):
+            if not line:
+                continue
+            rows.append(line[0])
+            cols.append(line[1])
+    if args.field_type == "int":
+        body = {"field": args.field,
+                "cols": [int(c) for c in rows],
+                "values": [int(v) for v in cols]}
+        path = f"/index/{args.index}/import-values"
+    else:
+        if args.keys:
+            body = {"field": args.field, "rowKeys": rows, "colKeys": cols,
+                    "rows": [], "cols": []}
+        else:
+            body = {"field": args.field,
+                    "rows": [int(r) for r in rows],
+                    "cols": [int(c) for c in cols]}
+        path = f"/index/{args.index}/import"
+    _http(args.host, "POST", path, body=json.dumps(body).encode())
+    print(f"imported {len(rows)} rows into {args.index}/{args.field}",
+          file=sys.stderr)
+    return 0
+
+
+def cmd_export(args) -> int:
+    """CSV export of a set field as ``row,col`` lines (reference:
+    ctl/export.go)."""
+    import json
+
+    q = f"Rows({args.field})"
+    with _http(args.host, "POST", f"/index/{args.index}/query",
+               body=q.encode()) as resp:
+        rows = json.loads(resp.read())["results"][0]
+    w = csv.writer(sys.stdout)
+    for row in rows:
+        rq = f"Row({args.field}={json.dumps(row)})"
+        with _http(args.host, "POST", f"/index/{args.index}/query",
+                   body=rq.encode()) as resp:
+            res = json.loads(resp.read())["results"][0]
+        for col in res.get("columns") or res.get("keys") or []:
+            w.writerow([row, col])
+    return 0
+
+
+def cmd_fbsql(args) -> int:
+    from pilosa_tpu.ctl.fbsql import Shell
+
+    return Shell(host=args.host).run()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pilosa-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run a server node")
+    s.add_argument("--config", help="TOML config file")
+    s.add_argument("--bind", default=None)
+    s.add_argument("--port", type=int, default=None)
+    s.add_argument("--data-dir", dest="data_dir", default=None)
+    s.add_argument("--wal-sync", dest="wal_sync", default=None,
+                   choices=("always", "batch", "never"))
+    s.set_defaults(fn=cmd_server)
+
+    g = sub.add_parser("generate-config", help="print default TOML config")
+    g.set_defaults(fn=cmd_generate_config)
+
+    for name, fn, extra in (
+        ("backup", cmd_backup, [("--output", dict(required=True))]),
+        ("restore", cmd_restore, [("--source", dict(required=True))]),
+        ("chksum", cmd_chksum, []),
+    ):
+        c = sub.add_parser(name)
+        c.add_argument("--host", default="http://127.0.0.1:10101")
+        for flag, kw in extra:
+            c.add_argument(flag, **kw)
+        c.set_defaults(fn=fn)
+
+    i = sub.add_parser("import", help="CSV import")
+    i.add_argument("--host", default="http://127.0.0.1:10101")
+    i.add_argument("--index", required=True)
+    i.add_argument("--field", required=True)
+    i.add_argument("--field-type", dest="field_type", default="set",
+                   choices=("set", "int"))
+    i.add_argument("--keys", action="store_true")
+    i.add_argument("file")
+    i.set_defaults(fn=cmd_import)
+
+    e = sub.add_parser("export", help="CSV export of a set field")
+    e.add_argument("--host", default="http://127.0.0.1:10101")
+    e.add_argument("--index", required=True)
+    e.add_argument("--field", required=True)
+    e.set_defaults(fn=cmd_export)
+
+    f = sub.add_parser("fbsql", help="interactive SQL shell")
+    f.add_argument("--host", default="http://127.0.0.1:10101")
+    f.set_defaults(fn=cmd_fbsql)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

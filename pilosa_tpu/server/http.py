@@ -63,6 +63,10 @@ _ROUTES = [
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/info$"), "get_info"),
+    # backup/restore/chksum (reference: ctl/backup.go internal endpoints)
+    ("GET", re.compile(r"^/internal/backup\.tar$"), "get_backup_tar"),
+    ("POST", re.compile(r"^/internal/restore$"), "post_restore"),
+    ("GET", re.compile(r"^/internal/chksum$"), "get_chksum"),
     # observability (reference: http_handler.go:495-497, :540)
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
@@ -236,6 +240,27 @@ class Handler(BaseHTTPRequestHandler):
             remote=bool(b.get("remote", False)),
         )
         self._send(200, {"imported": n})
+
+    def get_backup_tar(self):
+        import io
+
+        buf = io.BytesIO()
+        self.api.backup_tar(buf)
+        body = buf.getvalue()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-gtar")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def post_restore(self):
+        import io
+
+        self.api.restore_tar(io.BytesIO(self._body()))
+        self._send(200, {"success": True})
+
+    def get_chksum(self):
+        self._send(200, {"checksum": self.api.checksum()})
 
     def get_metrics(self):
         from pilosa_tpu.obs.metrics import REGISTRY

@@ -100,6 +100,75 @@ def load_holder_data(holder: "Holder") -> None:
         idx.dataframe.load()
 
 
+def export_holder(holder: "Holder", root: str) -> None:
+    """Write a complete, self-contained snapshot tree under ``root`` —
+    schema + fragments + BSI + dataframe + translate journals — the
+    payload of `backup` (reference: ctl/backup.go streaming schema,
+    shard snapshots, translate partitions). Works for path-less holders
+    too (translate stores are dumped from memory)."""
+    import json as _json
+
+    os.makedirs(root, exist_ok=True)
+    schema = {
+        "indexes": [
+            {
+                "name": idx.name,
+                "options": idx.options.to_json(),
+                "fields": [
+                    {"name": f.name, "options": f.options.to_json()}
+                    for f in idx.public_fields()
+                ],
+            }
+            for idx in sorted(holder.indexes.values(), key=lambda i: i.name)
+        ]
+    }
+    with open(os.path.join(root, "schema.json"), "w") as f:
+        _json.dump(schema, f, indent=1)
+    for idx in holder.indexes.values():
+        idx_path = os.path.join(root, "indexes", idx.name)
+        for field in idx.fields.values():
+            for view, frags in field.views.items():
+                for shard, frag in frags.items():
+                    n = len(frag.row_ids)
+                    _atomic_savez(
+                        os.path.join(_views_dir(idx_path, field.name), view,
+                                     f"frag.{shard}.npz"),
+                        planes=frag.planes[:n],
+                        row_ids=np.asarray(frag.row_ids, dtype=np.uint64),
+                    )
+            for shard, bfrag in field.bsi.items():
+                _atomic_savez(
+                    os.path.join(_bsi_dir(idx_path, field.name),
+                                 f"frag.{shard}.npz"),
+                    planes=bfrag.planes,
+                )
+            if field.translate is not None:
+                _dump_translate(
+                    field.translate.key_to_id,
+                    os.path.join(idx_path, "fields", field.name, "keys.jsonl"))
+        if idx.translate is not None:
+            _dump_translate(idx.translate.key_to_id,
+                            os.path.join(idx_path, "keys.jsonl"))
+        df = idx.dataframe
+        for shard, frame in df.frames.items():
+            arrays = {}
+            for name, col in frame.columns.items():
+                arrays[f"c:{name}"] = col
+                arrays[f"v:{name}"] = frame.valid[name]
+            _atomic_savez(
+                os.path.join(idx_path, "dataframe", f"shard.{shard}.npz"),
+                **arrays)
+
+
+def _dump_translate(key_to_id, path: str) -> None:
+    import json as _json
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for key, id_ in sorted(key_to_id.items(), key=lambda kv: kv[1]):
+            f.write(_json.dumps([key, id_]) + "\n")
+
+
 def _atomic_savez(path: str, **arrays) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"

@@ -1,0 +1,160 @@
+"""CLI, config system, backup/restore/chksum, fbsql shell.
+
+Reference analogs: ctl/backup_test.go round-trips, server/config tests,
+cli/ tests.
+"""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.config import Config
+from pilosa_tpu.ctl.cli import build_parser, main
+from pilosa_tpu.ctl.fbsql import Shell
+from pilosa_tpu.server.http import serve
+
+
+@pytest.fixture
+def server():
+    api = API()
+    srv, _ = serve(api, port=0, background=True)
+    yield api, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def fill(api):
+    api.create_index("b", {"keys": False})
+    api.create_field("b", "f")
+    api.create_field("b", "n", {"type": "int"})
+    api.query("b", "Set(1, f=2)Set(9, f=2)Set(1, n=77)")
+    api.import_dataframe("b", 0, [1, 9], {"fare": [1.5, 2.5]})
+    api.create_index("k", {"keys": True})
+    api.create_field("k", "g", {"keys": True})
+    api.query("k", 'Set("alice", g="admin")')
+
+
+class TestConfig:
+    def test_layering(self, tmp_path):
+        toml = tmp_path / "c.toml"
+        toml.write_text('port = 7000\ndata-dir = "/x"\n[auth]\nenable = true\n')
+        cfg = Config.from_sources(
+            toml_path=str(toml),
+            env={"PILOSA_TPU_PORT": "8000", "PILOSA_TPU_PEERS": "a,b"},
+            flags={"bind": "0.0.0.0", "port": None})
+        assert cfg.port == 8000          # env beats toml
+        assert cfg.data_dir == "/x"      # toml beats default
+        assert cfg.auth_enable is True   # [section] key flattening
+        assert cfg.peers == ["a", "b"]   # env list parsing
+        assert cfg.bind == "0.0.0.0"     # flag beats all
+        # None flags don't override
+        assert Config.from_sources(flags={"port": None}).port == 10101
+
+    def test_generate_config_roundtrip(self, tmp_path):
+        text = Config().to_toml()
+        p = tmp_path / "gen.toml"
+        p.write_text(text)
+        assert Config.from_sources(toml_path=str(p)) == Config()
+
+
+class TestBackupRestore:
+    def test_tar_roundtrip_between_servers(self, server):
+        api, host = server
+        fill(api)
+        want_sum = api.checksum()
+        # backup over HTTP
+        with urllib.request.urlopen(host + "/internal/backup.tar") as r:
+            blob = r.read()
+        # restore into a second, different server with junk pre-state
+        api2 = API()
+        api2.create_index("junk")
+        api2.restore_tar(io.BytesIO(blob))
+        assert "junk" not in api2.holder.indexes
+        assert api2.query("b", "Row(f=2)")[0].columns == [1, 9]
+        assert api2.query("b", "Sum(field=n)")[0].val == 77
+        assert api2.query("b", 'Apply("sum(fare)")')[0].value == pytest.approx(4.0)
+        assert api2.query("k", 'Row(g="admin")')[0].keys == ["alice"]
+        assert api2.checksum() == want_sum
+
+    def test_restore_into_durable_server(self, server, tmp_path):
+        api, host = server
+        fill(api)
+        buf = io.BytesIO()
+        api.backup_tar(buf)
+        api3 = API(str(tmp_path))
+        api3.restore_tar(io.BytesIO(buf.getvalue()))
+        del api3
+        api4 = API(str(tmp_path))  # restored state is durable
+        assert api4.query("b", "Row(f=2)")[0].columns == [1, 9]
+        assert api4.checksum() == api.checksum()
+
+    def test_checksum_changes_with_data(self, server):
+        api, _ = server
+        fill(api)
+        a = api.checksum()
+        api.query("b", "Set(5, f=2)")
+        assert api.checksum() != a
+
+
+class TestCLI:
+    def test_generate_config_cmd(self, capsys):
+        assert main(["generate-config"]) == 0
+        assert "data-dir" in capsys.readouterr().out
+
+    def test_backup_restore_chksum_cmds(self, server, tmp_path, capsys):
+        api, host = server
+        fill(api)
+        out = tmp_path / "b.tar.gz"
+        assert main(["backup", "--host", host, "--output", str(out)]) == 0
+        assert out.stat().st_size > 0
+        assert main(["chksum", "--host", host]) == 0
+        sum1 = capsys.readouterr().out.strip()
+        assert sum1 == api.checksum()
+        # wipe and restore over HTTP
+        api.delete_index("b")
+        assert main(["restore", "--host", host, "--source", str(out)]) == 0
+        assert api.query("b", "Row(f=2)")[0].columns == [1, 9]
+
+    def test_import_export_cmds(self, server, tmp_path, capsys):
+        api, host = server
+        api.create_index("ie")
+        api.create_field("ie", "f")
+        api.create_field("ie", "v", {"type": "int"})
+        csvf = tmp_path / "in.csv"
+        csvf.write_text("1,10\n1,11\n2,10\n")
+        assert main(["import", "--host", host, "--index", "ie",
+                     "--field", "f", str(csvf)]) == 0
+        assert api.query("ie", "Row(f=1)")[0].columns == [10, 11]
+        vals = tmp_path / "vals.csv"
+        vals.write_text("10,50\n11,-3\n")
+        assert main(["import", "--host", host, "--index", "ie",
+                     "--field", "v", "--field-type", "int", str(vals)]) == 0
+        assert api.query("ie", "Sum(field=v)")[0].val == 47
+        assert main(["export", "--host", host, "--index", "ie",
+                     "--field", "f"]) == 0
+        lines = sorted(capsys.readouterr().out.strip().splitlines())
+        assert lines == ["1,10", "1,11", "2,10"]
+
+
+class TestFbsql:
+    def test_shell_statements_and_meta(self, server):
+        api, host = server
+        api.create_index("s1")
+        api.create_field("s1", "f")
+        api.query("s1", "Set(1, f=1)")
+        stdin = io.StringIO(
+            "select count(*) from s1\n"
+            "\\dt\n"
+            "\\timing\n"
+            "select _id from s1\n"
+            "bogus sql here\n"
+            "\\q\n")
+        out = io.StringIO()
+        assert Shell(host=host, stdin=stdin, stdout=out).run() == 0
+        text = out.getvalue()
+        assert "count" in text
+        assert "s1" in text          # \dt listing
+        assert "Timing is on." in text
+        assert "error:" in text      # bad SQL surfaced, shell kept going
