@@ -13,7 +13,9 @@ Run on real TPU hardware by the round driver; also runs on CPU.
 """
 
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -44,7 +46,45 @@ def _build(rng, holder):
     return idx
 
 
+def _select_backend() -> None:
+    """Bound JAX backend init so a metric is ALWAYS emitted.
+
+    On tunneled TPU hosts the hardware backend can hang or die at init
+    ("Unable to initialize backend ..."). Probe it in a subprocess with a
+    timeout, retry once, then pin this process to CPU. The metric label
+    carries the device kind either way, so a CPU-fallback number is
+    clearly labeled as such.
+    """
+    from pilosa_tpu.platform import force_cpu_platform
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu_platform()  # pin the config too (sitecustomize hooks)
+        return
+    # Probe whatever platform is configured (axon/tpu preset or default)
+    # in a subprocess that inherits this env, bounded, with one retry.
+    probe = "import jax; jax.devices()"
+    for timeout_s in (120, 60):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout_s, capture_output=True, text=True,
+                start_new_session=True)
+            if r.returncode == 0:
+                return  # configured backend is healthy
+            err = r.stderr.strip().splitlines()
+            print("bench: backend probe errored: "
+                  + (err[-1] if err else f"rc={r.returncode}"),
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: backend probe hung (timeout={timeout_s}s)",
+                  file=sys.stderr)
+    print("bench: configured backend unhealthy; falling back to CPU",
+          file=sys.stderr)
+    force_cpu_platform()
+
+
 def main() -> None:
+    _select_backend()
     import jax
 
     from pilosa_tpu.core import Holder
@@ -94,4 +134,43 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("PILOSA_BENCH_CHILD"):
+        sys.exit(main())
+    # Orchestrator (imports no jax): run the benchmark in a child with a
+    # hard timeout — a hung/flaky accelerator tunnel must never leave the
+    # round without a number — then fall back to a CPU child.
+    def run_child(env, timeout):
+        # New session + group kill so a hung backend-probe grandchild
+        # cannot outlive the child and keep the accelerator locked.
+        proc = subprocess.Popen([sys.executable, __file__], env=env,
+                                start_new_session=True)
+        try:
+            return proc.wait(timeout=timeout), None
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            return None, f"timed out after {timeout}s"
+
+    env = dict(os.environ, PILOSA_BENCH_CHILD="1")
+    budget = int(os.environ.get("PILOSA_BENCH_TIMEOUT", "900"))
+    rc, failure = run_child(env, budget)
+    if rc == 0:
+        sys.exit(0)
+    failure = failure or f"failed (rc={rc})"
+    if env.get("JAX_PLATFORMS") == "cpu":
+        print(f"bench: CPU child {failure}; nothing left to try",
+              file=sys.stderr)
+        sys.exit(1)
+    print(f"bench: child {failure} on configured backend; re-running on CPU",
+          file=sys.stderr)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc, failure = run_child(env, 2 * budget)
+    if rc != 0:
+        print(f"bench: CPU child {failure or f'failed (rc={rc})'}",
+              file=sys.stderr)
+    sys.exit(rc if rc is not None else 1)

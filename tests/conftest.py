@@ -13,17 +13,11 @@ Set PILOSA_TPU_TEST_REAL=1 to run the suite on a real TPU instead.
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from pilosa_tpu.platform import ensure_virtual_devices, force_cpu_platform
 
+ensure_virtual_devices(8)
 if not os.environ.get("PILOSA_TPU_TEST_REAL"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_platform()
 
 import numpy as np
 import pytest
