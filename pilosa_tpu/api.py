@@ -88,13 +88,26 @@ class API:
 
     def query(self, index: str, pql: str,
               shards: Optional[Sequence[int]] = None) -> List[Any]:
+        from pilosa_tpu.pql import parse
+        from pilosa_tpu.pql.executor import has_write_calls
+
         M.REGISTRY.count(M.METRIC_PQL_QUERIES)
         rec = self.history.begin(index, pql if isinstance(pql, str) else "",
                                  "pql")
         span = get_tracer().start_span("executor.Execute", index=index)
         try:
-            with self.txf.qcx():  # group-commits any write calls' WAL records
-                out = self.executor.execute(index, pql, shards=shards)
+            parsed = parse(pql) if isinstance(pql, str) else pql
+            # Writes hold the holder write lock for the request and
+            # group-commit their WAL records at finish (the reference's
+            # write-Tx half of Qcx); pure reads take no lock — they see
+            # versioned stacked-cache snapshots, and stack *builds*
+            # serialize against writers internally (core/stacked.py).
+            import contextlib
+
+            ctx = (self.txf.qcx() if has_write_calls(parsed)
+                   else contextlib.nullcontext())
+            with ctx:
+                out = self.executor.execute(index, parsed, shards=shards)
             self.history.end(rec)
             return out
         except Exception as e:
@@ -291,7 +304,13 @@ class API:
             with self.holder.write_lock:
                 for name in list(self.holder.indexes):
                     self.holder.delete_index(name)
-                src = Holder(tmp)
+                # readonly: loads the checkpoint snapshot ONLY. Backups
+                # are checkpoint-complete by construction (export_holder),
+                # so any wal.log inside the archive is unexpected — and
+                # replaying one would unpickle attacker-controlled bytes
+                # from an untrusted backup file. readonly also opens no
+                # WAL handles, so nothing leaks into the tempdir cleanup.
+                src = Holder(tmp, readonly=True)
                 src.recover()
                 # rebuild through our own holder so WALs/paths attach to
                 # THIS server's data dir, then copy the loaded planes over

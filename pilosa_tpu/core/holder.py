@@ -18,9 +18,13 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 class Holder:
     def __init__(self, path: Optional[str] = None, wal_sync: str = "batch",
-                 checkpoint_bytes: int = 64 << 20):
+                 checkpoint_bytes: int = 64 << 20, readonly: bool = False):
         self.path = path
         self.wal_sync = wal_sync
+        # readonly: open for a snapshot-only read pass (restore/inspect) —
+        # no WAL handles are created and recover() refuses to replay logs
+        # (a foreign wal.log is untrusted input; see API.restore_tar).
+        self.readonly = readonly
         # WAL size that triggers an automatic checkpoint (snapshot +
         # truncate) — the analog of RBF's MaxWALCheckpointSize
         # (rbf/cfg/cfg.go:10-13).
@@ -81,12 +85,13 @@ class Holder:
 
     def _new_index(self, name: str, options: Optional[IndexOptions]) -> Index:
         wal = None
-        if self.path:
+        if self.path and not self.readonly:
             from pilosa_tpu.storage.wal import WAL
 
             wal = WAL(os.path.join(self._index_path(name), "wal.log"),
                       sync=self.wal_sync)
-        idx = Index(name, options, path=self._index_path(name), wal=wal)
+        idx = Index(name, options, path=self._index_path(name), wal=wal,
+                    lock=self.write_lock)
         self.indexes[name] = idx
         return idx
 

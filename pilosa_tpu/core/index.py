@@ -22,13 +22,19 @@ EXISTENCE_ROW = 0
 
 class Index:
     def __init__(self, name: str, options: Optional[IndexOptions] = None,
-                 path: Optional[str] = None, wal=None):
+                 path: Optional[str] = None, wal=None, lock=None):
         if not name or not name[0].isalpha() or name != name.lower():
             raise ValueError(f"invalid index name {name!r}")
         self.name = name
         self.options = options or IndexOptions()
         self.path = path
         self.wal = wal  # per-index write-ahead log (storage/wal.py)
+        # One writer lock shared down the ownership tree (holder passes
+        # its own): stacked-view builds hold it so lock-free readers never
+        # see a half-applied write (core/stacked.py build serialization).
+        import threading
+
+        self.write_lock = lock if lock is not None else threading.RLock()
         self.fields: Dict[str, Field] = {}
         # Record keys are partition-hashed so key ownership == shard
         # ownership across a cluster (reference: translate.go:103).
@@ -55,6 +61,7 @@ class Index:
     def _create_field_object(self, name: str, options: FieldOptions) -> Field:
         field = Field(self.name, name, options, path=self._field_path(name))
         field.wal = self.wal
+        field.write_lock = self.write_lock
         self.fields[name] = field
         return field
 

@@ -25,6 +25,7 @@ fragment.py; incremental device merge is a later optimization).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -165,26 +166,52 @@ def _cache_put(field, group, subset, vers, built):
             inner.popitem(last=False)
 
 
+def _writer_lock(field):
+    """The holder-wide writer lock threaded down to the field (RLock, so
+    writers building a stack mid-request re-enter fine). Standalone fields
+    constructed outside an Index (unit tests) have none."""
+    lock = getattr(field, "write_lock", None)
+    return lock if lock is not None else contextlib.nullcontext()
+
+
 def stacked_set(field, shards: Sequence[int], view: str) -> StackedSet:
-    """Build-or-reuse the stacked view of ``field``'s ``view`` fragments."""
+    """Build-or-reuse the stacked view of ``field``'s ``view`` fragments.
+
+    The fragment fetch + version snapshot + host build run under the
+    writer lock: reads themselves are lock-free on cache hits, but a
+    *build* walks live host planes and must not observe a half-applied
+    write (torn plane) or a mid-resize row index.
+    """
     group, subset = ("set", view), tuple(shards)
+    # Optimistic lock-free hit: a cached stack is an immutable device
+    # array — serving it is always safe, and the dict/version reads here
+    # are individually atomic. Only a MISS (which walks live host planes)
+    # must serialize against writers.
     fragments = [field.fragment(s, view) for s in shards]
-    vers = _versions(fragments)
-    hit = _cache_get(field, group, subset, vers)
+    hit = _cache_get(field, group, subset, _versions(fragments))
     if hit is not None:
         return hit
-    built = StackedSet(shards, fragments)
-    _cache_put(field, group, subset, vers, built)
-    return built
+    with _writer_lock(field):
+        fragments = [field.fragment(s, view) for s in shards]
+        vers = _versions(fragments)
+        hit = _cache_get(field, group, subset, vers)
+        if hit is None:
+            hit = StackedSet(shards, fragments)
+            _cache_put(field, group, subset, vers, hit)
+    return hit
 
 
 def stacked_bsi(field, shards: Sequence[int]) -> StackedBSI:
     group, subset = ("bsi",), tuple(shards)
     fragments = [field.bsi_fragment(s) for s in shards]
-    vers = _versions(fragments)
-    hit = _cache_get(field, group, subset, vers)
+    hit = _cache_get(field, group, subset, _versions(fragments))
     if hit is not None:
         return hit
-    built = StackedBSI(shards, fragments)
-    _cache_put(field, group, subset, vers, built)
-    return built
+    with _writer_lock(field):
+        fragments = [field.bsi_fragment(s) for s in shards]
+        vers = _versions(fragments)
+        hit = _cache_get(field, group, subset, vers)
+        if hit is None:
+            hit = StackedBSI(shards, fragments)
+            _cache_put(field, group, subset, vers, hit)
+    return hit

@@ -54,6 +54,22 @@ _BITMAP_CALLS = {"Row", "Union", "Intersect", "Difference", "Xor", "Not",
 _WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store", "Delete"}
 
 
+def has_write_calls(query) -> bool:
+    """True if any call in the (parsed) query mutates data. Lets the API
+    layer skip the Qcx/write-lock for pure reads (the reference's Qcx
+    likewise distinguishes read from write Tx, txfactory.go:84)."""
+
+    def walk(call) -> bool:
+        if call.name in _WRITE_CALLS:
+            return True
+        if call.name == "ExternalLookup" and call.arg("write"):
+            return True  # write-mode lookups keep single-writer ordering
+        return any(walk(c) for c in call.children)
+
+    calls = query.calls if isinstance(query, Query) else [query]
+    return any(walk(c) for c in calls)
+
+
 def _parse_ts(v) -> dt.datetime:
     if isinstance(v, dt.datetime):
         return v

@@ -75,11 +75,14 @@ class SQLEngine:
         if isinstance(stmt, ast.AlterTable):
             return self._alter_table(stmt)
         if isinstance(stmt, ast.InsertStatement):
-            return self._insert(stmt)
+            with self.api.txf.qcx():  # DML holds the write lock + group-commits
+                return self._insert(stmt)
         if isinstance(stmt, ast.BulkInsert):
-            return self._bulk_insert(stmt)
+            with self.api.txf.qcx():
+                return self._bulk_insert(stmt)
         if isinstance(stmt, ast.DeleteStatement):
-            return self._delete(stmt)
+            with self.api.txf.qcx():
+                return self._delete(stmt)
         if isinstance(stmt, ast.ShowTables):
             return self._show_tables()
         if isinstance(stmt, ast.ShowColumns):
@@ -111,6 +114,7 @@ class SQLEngine:
         except Exception:
             self.api.delete_index(ct.name)
             raise
+        self.api.holder.save_schema()
         return SQLResult(schema=[], data=[])
 
     def _drop_table(self, d: ast.DropTable) -> SQLResult:
@@ -126,7 +130,9 @@ class SQLEngine:
         if a.add is not None:
             idx.create_field(a.add.name, column_to_field_options(a.add))
         elif a.drop is not None:
-            idx.delete_field(a.drop)
+            with self.api.txf.qcx():  # flushes the delete_field tombstone
+                idx.delete_field(a.drop)
+        self.api.holder.save_schema()
         return SQLResult(schema=[], data=[])
 
     # -- DML ------------------------------------------------------------------

@@ -158,3 +158,43 @@ class TestFbsql:
         assert "s1" in text          # \dt listing
         assert "Timing is on." in text
         assert "error:" in text      # bad SQL surfaced, shell kept going
+
+
+class TestRestoreSafety:
+    def test_restore_never_unpickles_wal(self, tmp_path):
+        """A wal.log inside a backup tar is untrusted input: legitimate
+        backups are checkpoint-complete and contain no WAL, so restore
+        must load the snapshot only — never pickle-replay (advisor r1
+        medium: arbitrary code execution via crafted backup)."""
+        import pickle
+        import tarfile
+
+        api = API()
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.query("i", "Set(3, f=1)")
+        buf = io.BytesIO()
+        api.backup_tar(buf)
+
+        class Evil:
+            def __reduce__(self):
+                marker = str(tmp_path / "pwned")
+                return (open, (marker, "w"))
+
+        # graft a malicious wal.log into the archive
+        src = io.BytesIO(buf.getvalue())
+        out = io.BytesIO()
+        with tarfile.open(fileobj=src, mode="r|*") as tin, \
+                tarfile.open(fileobj=out, mode="w|gz") as tout:
+            for m in tin:
+                tout.addfile(m, tin.extractfile(m) if m.isfile() else None)
+            payload = pickle.dumps(Evil())
+            rec = len(payload).to_bytes(8, "little") + payload
+            info = tarfile.TarInfo("./indexes/i/wal.log")
+            info.size = len(rec)
+            tout.addfile(info, io.BytesIO(rec))
+
+        api2 = API()
+        api2.restore_tar(io.BytesIO(out.getvalue()))
+        assert not (tmp_path / "pwned").exists(), "restore unpickled a WAL"
+        assert api2.query("i", "Row(f=1)")[0].columns == [3]
