@@ -40,6 +40,17 @@ from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 _MIN_SLOTS = 8
 
 
+def _engine_put(host: np.ndarray) -> jax.Array:
+    """Place a stacked tensor on the engine device mesh: the fused
+    (shard, word) last axis splits across all mesh devices, so the jitted
+    query kernels execute SPMD with XLA-inserted collective reduces
+    (parallel/mesh.py engine mesh; the reference's shard->node scatter +
+    HTTP reduce, executor.go:6449, becomes shard->device + psum)."""
+    from pilosa_tpu.parallel.mesh import engine_put
+
+    return engine_put(host)
+
+
 def _pow2(n: int) -> int:
     cap = _MIN_SLOTS
     while cap < n:
@@ -68,7 +79,7 @@ class StackedSet:
             lo = si * words
             for slot, row in enumerate(frag.row_ids):
                 host[self.row_index[row], lo:lo + words] = frag.planes[slot]
-        self.planes: jax.Array = jax.device_put(host)
+        self.planes: jax.Array = _engine_put(host)
         self._zero: Optional[jax.Array] = None
 
     def zero_plane(self) -> jax.Array:
@@ -112,14 +123,20 @@ class StackedBSI:
                 continue
             lo = si * words
             host[: frag.planes.shape[0], lo:lo + words] = frag.planes
-        self.planes: jax.Array = jax.device_put(host)
+        self.planes: jax.Array = _engine_put(host)
 
     def exists_plane(self) -> jax.Array:
         return self.planes[bsiops.EXISTS]
 
 
 def _versions(fragments) -> Tuple:
-    return tuple(-1 if f is None else f.version for f in fragments)
+    from pilosa_tpu.parallel.mesh import mesh_epoch
+
+    # The mesh epoch is part of the version key: a mesh switch must
+    # invalidate stacks placed on the old device set (mixed placements in
+    # one kernel error out rather than resharding).
+    return (mesh_epoch(),) + tuple(
+        -1 if f is None else f.version for f in fragments)
 
 
 # Cache layout: field._stacked_cache maps a *group* (kind, view) to an
