@@ -21,6 +21,7 @@ reusing container code paths across fragments).
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -31,6 +32,68 @@ from pilosa_tpu.ops.bitmap import bits_to_plane
 from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
 
 _MIN_CAPACITY = 8
+
+# Write-delta log bounds (the incremental device-merge path,
+# core/stacked.py): more pending ops than this and a full re-stack is
+# cheaper than scattering, so the log resets and the next stack build
+# re-uploads (the RBF WAL -> checkpoint transition, rbf/db.go:149-230).
+_DELTA_MAX_OPS = 512
+_DELTA_MAX_COLS = 4096
+
+
+class _DeltaLog:
+    """Ordered log of representable writes since a fragment version.
+
+    An op is *representable* when it can be replayed onto an
+    already-stacked device tensor as per-(row, word) OR/ANDNOT masks —
+    i.e. it touched existing rows only and didn't restructure the
+    fragment (no new row slots, no capacity growth, no bulk plane
+    replacement, no BSI depth growth). ``base`` is the fragment version
+    the log is complete since; advancing a stack built at version v is
+    possible iff v >= base.
+    """
+
+    def __init__(self):
+        self.base = 0
+        self.head = 0  # version after the last logged/reset write
+        self.cost = 0  # cumulative replay cost (columns) of pending ops
+        self.ops: deque = deque()
+
+    def record(self, version: int, payload, cost: int = 1) -> None:
+        # A version gap means something bumped fragment.version without
+        # logging (restore/snapshot copies replace planes wholesale) —
+        # the log can no longer bridge across that write. version ==
+        # head is a continuation of the current bump (set_many logs one
+        # payload per row under a single version).
+        if version not in (self.head, self.head + 1):
+            self.reset(version)
+            return
+        # Bound REPLAY work, not just op count: replay cost is per
+        # column (BSI ops fan out to every plane), so a few wide ops can
+        # cost more to scatter than a full rebuild+upload.
+        if len(self.ops) >= _DELTA_MAX_OPS or self.cost + cost > _DELTA_MAX_COLS:
+            self.reset(version)
+            return
+        self.ops.append((version, payload))
+        self.head = version
+        self.cost += cost
+
+    def reset(self, version: int) -> None:
+        """Non-representable write (or overflow): merges from any older
+        base become impossible."""
+        self.ops.clear()
+        self.base = version
+        self.head = version
+        self.cost = 0
+
+    def since(self, base_version: int, current_version: int):
+        """Payloads after ``base_version``, or None when the log can't
+        bridge from there. ``current_version`` guards against version
+        bumps that bypassed the logging write methods (restore/snapshot
+        copies mutate planes and bump version directly)."""
+        if base_version < self.base or current_version > self.head:
+            return None
+        return [p for v, p in self.ops if v > base_version]
 
 
 def _grow_rows(planes: np.ndarray, need: int) -> np.ndarray:
@@ -56,6 +119,9 @@ class SetFragment:
         self.version = 0
         self._device: Optional[jax.Array] = None
         self._device_version = -1
+        # (row, set_cols, clear_cols) payloads for the incremental device
+        # merge (core/stacked.py _try_advance)
+        self.deltas = _DeltaLog()
 
     # -- host write path ---------------------------------------------------
 
@@ -71,6 +137,7 @@ class SetFragment:
     def set_bit(self, row: int, col: int) -> bool:
         """Set bit; returns True if it changed (reference: fragment.go
         setBit's changed flag feeding import counts)."""
+        new_row = row not in self.row_index
         s = self._slot(row)
         w, b = divmod(col, BITS_PER_WORD)
         mask = np.uint32(1) << np.uint32(b)
@@ -79,6 +146,10 @@ class SetFragment:
             return False
         self.planes[s, w] = old | mask
         self.version += 1
+        if new_row:  # structure change: stacks must rebuild
+            self.deltas.reset(self.version)
+        else:
+            self.deltas.record(self.version, (row, (col,), ()))
         return True
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -92,6 +163,7 @@ class SetFragment:
             return False
         self.planes[s, w] = old & ~mask
         self.version += 1
+        self.deltas.record(self.version, (row, (), (col,)))
         return True
 
     def set_many(self, rows: Sequence[int], cols: Sequence[int]) -> int:
@@ -101,14 +173,22 @@ class SetFragment:
         cols = np.asarray(cols, dtype=np.int64)
         if rows.size == 0:
             return 0
+        new_rows = any(int(r) not in self.row_index for r in np.unique(rows))
         changed = 0
+        payloads = []
         for row in np.unique(rows):
             s = self._slot(int(row))
             sel = cols[rows == row]
             before = int(np.sum(popcount_words(self.planes[s])))
             self.planes[s] |= bits_to_plane(sel, self.words)
             changed += int(np.sum(popcount_words(self.planes[s]))) - before
+            payloads.append((int(row), tuple(int(c) for c in sel), ()))
         self.version += 1
+        if new_rows or cols.size > _DELTA_MAX_COLS:
+            self.deltas.reset(self.version)
+        else:
+            for p in payloads:
+                self.deltas.record(self.version, p, cost=len(p[1]))
         return changed
 
     def clear_column(self, col: int, except_row: Optional[int] = None) -> bool:
@@ -126,6 +206,8 @@ class SetFragment:
             return False
         col_words[to_clear] &= ~mask
         self.version += 1
+        for slot in np.nonzero(to_clear)[0]:
+            self.deltas.record(self.version, (self.row_ids[slot], (), (col,)))
         return True
 
     def import_row_plane(self, row: int, plane: np.ndarray, clear: bool = False):
@@ -137,6 +219,7 @@ class SetFragment:
         else:
             self.planes[s] |= plane
         self.version += 1
+        self.deltas.reset(self.version)  # bulk plane op: not delta-replayable
 
     def clear_row_plane_bits(self, row: int, plane: np.ndarray) -> bool:
         """Clear the bits of ``plane`` from a row; no-op (and no slot
@@ -146,6 +229,7 @@ class SetFragment:
             return False
         self.planes[s] &= ~plane
         self.version += 1
+        self.deltas.reset(self.version)
         return True
 
     def clear_plane(self, plane: np.ndarray) -> None:
@@ -157,6 +241,7 @@ class SetFragment:
             return
         self.planes[:n] &= ~plane
         self.version += 1
+        self.deltas.reset(self.version)
 
     # -- host read path ----------------------------------------------------
 
@@ -212,6 +297,9 @@ class BSIFragment:
         self.version = 0
         self._device: Optional[jax.Array] = None
         self._device_version = -1
+        # ("set", cols, values) / ("clear", col) payloads for incremental
+        # device merge; depth growth resets (plane count changed)
+        self.deltas = _DeltaLog()
 
     def _ensure_depth(self, depth: int):
         if depth <= self.depth:
@@ -237,12 +325,22 @@ class BSIFragment:
         cols, values = cols[idx], values[idx]
         need = max(bsiops.bits_needed(int(values.min())),
                    bsiops.bits_needed(int(values.max())))
+        grew = need > self.depth
         self._ensure_depth(need)
         clear = ~bits_to_plane(cols, self.words)
         self.planes &= clear[None, :]  # clear all planes for these columns
         update = bsiops.encode_values(cols, values, self.depth, self.words)
         self.planes[: update.shape[0]] |= update
         self.version += 1
+        if grew:
+            self.deltas.reset(self.version)
+        else:
+            # replay fans each column out to every plane row
+            self.deltas.record(
+                self.version,
+                ("set", tuple(int(c) for c in cols),
+                 tuple(int(v) for v in values)),
+                cost=cols.size * (bsiops.OFFSET + self.depth))
 
     def clear_value(self, col: int) -> bool:
         w, b = divmod(col, BITS_PER_WORD)
@@ -251,6 +349,8 @@ class BSIFragment:
             return False
         self.planes[:, w] &= ~mask
         self.version += 1
+        self.deltas.record(self.version, ("clear", col),
+                           cost=bsiops.OFFSET + self.depth)
         return True
 
     def value(self, col: int) -> Optional[int]:
@@ -275,6 +375,7 @@ class BSIFragment:
         deletion, reference: executor.go:9050 executeDeleteRecords)."""
         self.planes &= ~plane[None, :]
         self.version += 1
+        self.deltas.reset(self.version)
 
     def device_planes(self) -> jax.Array:
         if self._device is None or self._device_version != self.version:

@@ -40,6 +40,12 @@ from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 _MIN_SLOTS = 8
 
 
+# Full-stack uploads (host -> device transfers of whole stacked tensors).
+# The incremental write-merge path must NOT bump these — tests assert a
+# setbit between two queries costs a tiny scatter, not a re-upload.
+UPLOAD_STATS = {"count": 0, "bytes": 0}
+
+
 def _engine_put(host: np.ndarray) -> jax.Array:
     """Place a stacked tensor on the engine device mesh: the fused
     (shard, word) last axis splits across all mesh devices, so the jitted
@@ -48,6 +54,8 @@ def _engine_put(host: np.ndarray) -> jax.Array:
     HTTP reduce, executor.go:6449, becomes shard->device + psum)."""
     from pilosa_tpu.parallel.mesh import engine_put
 
+    UPLOAD_STATS["count"] += 1
+    UPLOAD_STATS["bytes"] += host.nbytes
     return engine_put(host)
 
 
@@ -171,6 +179,19 @@ def _cache_get(field, group, subset, vers):
         return None
 
 
+def _cache_peek(field, group, subset):
+    """Latest (vers, stack) for a subset regardless of staleness — the
+    merge base for the incremental advance path."""
+    with _LOCK:
+        cache = getattr(field, "_stacked_cache", None)
+        if cache is None:
+            return None
+        inner = cache.get(group)
+        if inner is None:
+            return None
+        return inner.get(subset)
+
+
 def _cache_put(field, group, subset, vers, built):
     with _LOCK:
         cache = getattr(field, "_stacked_cache", None)
@@ -181,6 +202,157 @@ def _cache_put(field, group, subset, vers, built):
         inner.move_to_end(subset)
         while len(inner) > _MAX_SUBSETS_PER_GROUP:
             inner.popitem(last=False)
+
+
+# ---------------------------------------------------------------------------
+# Incremental write-merge (VERDICT r1 #5; SURVEY §7 "Mutability on device").
+# A write between two queries used to invalidate the whole stacked tensor
+# and re-upload it. Instead, representable writes (existing rows only, no
+# structure change — fragment.py _DeltaLog) advance the cached device
+# tensor in place: the pending ops collapse host-side into final
+# per-(slot, fused-word) OR/ANDNOT masks (ordered, so set-then-clear of a
+# bit resolves correctly), and ONE jitted scatter applies them on device.
+# Transfer cost: a few hundred bytes of indices+masks, not the stack.
+# ---------------------------------------------------------------------------
+
+
+# NOTE: planes is NOT donated — lock-free readers may still hold the old
+# stack; donating its buffer would invalidate their in-flight reads.
+# Updates use mode="drop": inputs are padded to power-of-2 lengths with
+# out-of-bounds word indices (one XLA executable per pow2 bucket instead
+# of one per distinct delta count), and dropped pads can't race a real
+# entry the way a duplicated in-bounds pad index would.
+@jax.jit
+def _apply_bit_deltas(planes, slots, words, orm, anm):
+    cur = planes[slots, words]  # pads clamp-read; their writes are dropped
+    return planes.at[slots, words].set((cur & ~anm) | orm, mode="drop")
+
+
+class _MaskAccum:
+    """Ordered bit-op collapse into per-(slot, fused word) masks."""
+
+    def __init__(self):
+        self.masks: Dict[Tuple[int, int], List[int]] = {}
+
+    def set(self, slot: int, word: int, bit: int) -> None:
+        e = self.masks.setdefault((slot, word), [0, 0])
+        m = 1 << bit
+        e[0] |= m
+        e[1] &= ~m
+
+    def clear(self, slot: int, word: int, bit: int) -> None:
+        e = self.masks.setdefault((slot, word), [0, 0])
+        m = 1 << bit
+        e[1] |= m
+        e[0] &= ~m
+
+    def apply(self, planes: jax.Array) -> jax.Array:
+        keys = list(self.masks)
+        cap = _pow2(len(keys))
+        slots = np.zeros(cap, dtype=np.int32)
+        # pads point past the word axis: dropped by the scatter
+        words = np.full(cap, planes.shape[-1], dtype=np.int32)
+        orm = np.zeros(cap, dtype=np.uint32)
+        anm = np.zeros(cap, dtype=np.uint32)
+        for i, k in enumerate(keys):
+            slots[i], words[i] = k
+            orm[i], anm[i] = self.masks[k]
+        return _apply_bit_deltas(planes, slots, words, orm, anm)
+
+
+def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["StackedSet"]:
+    """Replay pending writes onto a cached StackedSet; None -> rebuild."""
+    from pilosa_tpu.shardwidth import BITS_PER_WORD
+
+    acc = _MaskAccum()
+    for si, (frag, built_v) in enumerate(zip(fragments, built_vers)):
+        if frag is None:
+            if built_v != -1:
+                return None  # fragment vanished
+            continue
+        if built_v == frag.version:
+            continue
+        if built_v < 0:
+            return None  # fragment appeared after the build
+        ops = frag.deltas.since(built_v, frag.version)
+        if ops is None:
+            return None
+        lo = si * stack.words
+        for row, set_cols, clear_cols in ops:
+            slot = stack.row_index.get(row)
+            if slot is None:
+                return None  # write touched a row the stack never saw
+            for col in set_cols:
+                w, b = divmod(col, BITS_PER_WORD)
+                acc.set(slot, lo + w, b)
+            for col in clear_cols:
+                w, b = divmod(col, BITS_PER_WORD)
+                acc.clear(slot, lo + w, b)
+    if not acc.masks:
+        return stack  # versions moved with no net representable delta
+    new = StackedSet.__new__(StackedSet)
+    new.shards = stack.shards
+    new.words = stack.words
+    new.total_words = stack.total_words
+    new.row_ids = stack.row_ids
+    new.row_index = stack.row_index
+    new.planes = acc.apply(stack.planes)
+    new._zero = None
+    return new
+
+
+def _advance_bsi(stack: "StackedBSI", fragments, built_vers) -> Optional["StackedBSI"]:
+    from pilosa_tpu.ops.bsi import EXISTS, OFFSET, SIGN
+    from pilosa_tpu.shardwidth import BITS_PER_WORD
+
+    n_planes = stack.planes.shape[0]
+    acc = _MaskAccum()
+    for si, (frag, built_v) in enumerate(zip(fragments, built_vers)):
+        if frag is None:
+            if built_v != -1:
+                return None
+            continue
+        if built_v == frag.version:
+            continue
+        if built_v < 0:
+            return None
+        if frag.planes.shape[0] > n_planes:
+            return None  # deeper than the stack: rebuild widens it
+        ops = frag.deltas.since(built_v, frag.version)
+        if ops is None:
+            return None
+        lo = si * stack.words
+        for op in ops:
+            if op[0] == "set":
+                _, cols, values = op
+                for col, val in zip(cols, values):
+                    w, b = divmod(col, BITS_PER_WORD)
+                    for p in range(n_planes):  # old value fully cleared
+                        acc.clear(p, lo + w, b)
+                    acc.set(EXISTS, lo + w, b)
+                    if val < 0:
+                        acc.set(SIGN, lo + w, b)
+                    mag = -val if val < 0 else val
+                    k = 0
+                    while mag:
+                        if mag & 1:
+                            acc.set(OFFSET + k, lo + w, b)
+                        mag >>= 1
+                        k += 1
+            else:  # ("clear", col)
+                _, col = op
+                w, b = divmod(col, BITS_PER_WORD)
+                for p in range(n_planes):
+                    acc.clear(p, lo + w, b)
+    if not acc.masks:
+        return stack
+    new = StackedBSI.__new__(StackedBSI)
+    new.shards = stack.shards
+    new.words = stack.words
+    new.total_words = stack.total_words
+    new.depth = stack.depth
+    new.planes = acc.apply(stack.planes)
+    return new
 
 
 def _writer_lock(field):
@@ -213,8 +385,10 @@ def stacked_set(field, shards: Sequence[int], view: str) -> StackedSet:
         vers = _versions(fragments)
         hit = _cache_get(field, group, subset, vers)
         if hit is None:
-            hit = StackedSet(shards, fragments)
-            _cache_put(field, group, subset, vers, hit)
+            hit = _advance_or_rebuild(
+                field, group, subset, vers, fragments,
+                advance=_advance_set,
+                rebuild=lambda: StackedSet(shards, fragments))
     return hit
 
 
@@ -229,6 +403,23 @@ def stacked_bsi(field, shards: Sequence[int]) -> StackedBSI:
         vers = _versions(fragments)
         hit = _cache_get(field, group, subset, vers)
         if hit is None:
-            hit = StackedBSI(shards, fragments)
-            _cache_put(field, group, subset, vers, hit)
+            hit = _advance_or_rebuild(
+                field, group, subset, vers, fragments,
+                advance=_advance_bsi,
+                rebuild=lambda: StackedBSI(shards, fragments))
     return hit
+
+
+def _advance_or_rebuild(field, group, subset, vers, fragments,
+                        advance, rebuild):
+    """On a version miss: try replaying the pending write deltas onto the
+    latest cached stack (one small device scatter); fall back to a full
+    host build + upload. Caller holds the writer lock."""
+    stale = _cache_peek(field, group, subset)
+    built = None
+    if stale is not None and stale[0][0] == vers[0]:  # same mesh epoch
+        built = advance(stale[1], fragments, stale[0][1:])
+    if built is None:
+        built = rebuild()
+    _cache_put(field, group, subset, vers, built)
+    return built

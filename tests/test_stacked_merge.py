@@ -1,0 +1,220 @@
+"""Incremental device write-merge (VERDICT r1 #5).
+
+A small write between two queries must advance the cached stacked tensor
+with a tiny device scatter — NOT invalidate it and re-upload the whole
+stack (SURVEY §7 "Mutability on device"; the reference's analog is RBF's
+WAL absorbing writes between checkpoints, rbf/db.go:149-230).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import FieldOptions, FieldType, Holder
+from pilosa_tpu.core.stacked import UPLOAD_STATS
+from pilosa_tpu.pql import Executor
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def env():
+    h = Holder()
+    e = Executor(h)
+    return h, e
+
+
+def uploads():
+    return UPLOAD_STATS["count"]
+
+
+def fill(e, rows=4, shards=2, per_row=50, field="f"):
+    rng = np.random.default_rng(9)
+    oracle = {r: set() for r in range(rows)}
+    for s in range(shards):
+        for r in range(rows):
+            for c in rng.integers(0, SHARD_WIDTH, per_row):
+                col = s * SHARD_WIDTH + int(c)
+                e.execute("i", f"Set({col}, {field}={r})")
+                oracle[r].add(col)
+    return oracle
+
+
+class TestSetMerge:
+    def test_setbit_between_queries_no_reupload(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        oracle = fill(e)
+        e.execute("i", "Count(Row(f=0))")  # warm: build + upload
+        base = uploads()
+        # representable write: existing row, existing structure
+        newcol = SHARD_WIDTH + 777
+        assert newcol not in oracle[0]
+        e.execute("i", f"Set({newcol}, f=0)")
+        oracle[0].add(newcol)
+        got = e.execute("i", "Count(Row(f=0))TopN(f, n=2)")
+        assert got[0] == len(oracle[0])
+        assert uploads() == base, "setbit caused a full stack re-upload"
+        # repeated writes keep merging without uploads
+        for k in range(5):
+            e.execute("i", f"Clear({sorted(oracle[0])[k]}, f=0)")
+            oracle[0].discard(sorted(oracle[0])[k])
+        assert e.execute("i", "Count(Row(f=0))")[0] == len(oracle[0])
+        assert uploads() == base
+
+    def test_set_then_clear_same_bit_resolves_in_order(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        fill(e)
+        e.execute("i", "Count(Row(f=1))")
+        c = SHARD_WIDTH + 4242
+        e.execute("i", f"Set({c}, f=1)")
+        e.execute("i", f"Clear({c}, f=1)")
+        assert c not in e.execute("i", "Row(f=1)")[0].columns
+        e.execute("i", f"Clear({c}, f=1)")
+        e.execute("i", f"Set({c}, f=1)")
+        assert c in e.execute("i", "Row(f=1)")[0].columns
+
+    def test_new_row_rebuilds(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        oracle = fill(e)
+        e.execute("i", "Count(Row(f=0))")
+        base = uploads()
+        e.execute("i", "Set(5, f=99)")  # new row: structure change
+        top = e.execute("i", "TopN(f, n=10)")[0]
+        assert (99, 1) in [(p.id, p.count) for p in top.pairs]
+        assert uploads() > base  # full rebuild happened (and is correct)
+        for r, cols in oracle.items():
+            assert e.execute("i", f"Count(Row(f={r}))")[0] == len(cols)
+
+    def test_merge_matches_fresh_rebuild(self, env):
+        """Merged stack must equal a from-scratch build bit for bit."""
+        h, e = env
+        h.create_index("i").create_field("f")
+        fill(e, rows=3, shards=3)
+        e.execute("i", "Count(Row(f=0))")
+        rng = np.random.default_rng(3)
+        for _ in range(40):
+            r = int(rng.integers(0, 3))
+            c = int(rng.integers(0, 3 * SHARD_WIDTH))
+            if rng.random() < 0.5:
+                e.execute("i", f"Set({c}, f={r})")
+            else:
+                e.execute("i", f"Clear({c}, f={r})")
+            e.execute("i", "Count(Row(f=0))")  # keep advancing the stack
+        merged = [e.execute("i", f"Row(f={r})")[0].columns for r in range(3)]
+        # fresh executor+holder state: drop caches, force full rebuild
+        for fld in h.index("i").fields.values():
+            if hasattr(fld, "_stacked_cache"):
+                fld._stacked_cache.clear()
+        fresh = [e.execute("i", f"Row(f={r})")[0].columns for r in range(3)]
+        assert merged == fresh
+
+    def test_mutex_write_merges(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("m", FieldOptions(type=FieldType.MUTEX))
+        for col, row in [(1, 0), (2, 0), (3, 1)]:
+            e.execute("i", f"Set({col}, m={row})")
+        e.execute("i", "Count(Row(m=0))")
+        base = uploads()
+        e.execute("i", "Set(2, m=1)")  # moves col 2: clear row0 + set row1
+        assert e.execute("i", "Row(m=0)")[0].columns == [1]
+        assert sorted(e.execute("i", "Row(m=1)")[0].columns) == [2, 3]
+        assert uploads() == base
+
+
+class TestBSIMerge:
+    def test_value_update_no_reupload(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        cols = list(range(0, 2000, 7))
+        vals = {c: (c % 97) - 48 for c in cols}
+        for fshard in (0, 1):
+            f = idx.field("n")
+            f.set_values([c + fshard * SHARD_WIDTH for c in cols],
+                         list(vals.values()))
+        assert e.execute("i", "Sum(field=n)")[0].val == 2 * sum(vals.values())
+        base = uploads()
+        f = idx.field("n")
+        f.set_values([14], [40])  # update within existing depth
+        want = 2 * sum(vals.values()) - vals[14] + 40
+        assert e.execute("i", "Sum(field=n)")[0].val == want
+        assert uploads() == base, "BSI value update caused re-upload"
+        # sign flip + clear also merge
+        f.set_values([21], [-5])
+        want += -5 - vals[21]
+        assert e.execute("i", "Sum(field=n)")[0].val == want
+        f.clear_value(28)
+        want -= vals[28]
+        assert e.execute("i", "Sum(field=n)")[0].val == want
+        assert uploads() == base
+
+    def test_depth_growth_rebuilds_correctly(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        f = idx.field("n")
+        f.set_values([1, 2, 3], [5, 6, 7])
+        assert e.execute("i", "Sum(field=n)")[0].val == 18
+        f.set_values([4], [1 << 40])  # depth growth: not representable
+        assert e.execute("i", "Sum(field=n)")[0].val == 18 + (1 << 40)
+
+    def test_range_after_merge(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        f = idx.field("n")
+        f.set_values(list(range(10)), list(range(10)))
+        assert e.execute("i", "Count(Row(n > 4))")[0] == 5
+        f.set_values([2], [9])
+        assert e.execute("i", "Count(Row(n > 4))")[0] == 6
+        assert sorted(e.execute("i", "Row(n == 9)")[0].columns) == [2, 9]
+
+
+class TestOverflow:
+    def test_delta_overflow_falls_back(self, env):
+        h, e = env
+        h.create_index("i").create_field("f")
+        fill(e, rows=2, shards=1, per_row=30)
+        e.execute("i", "Count(Row(f=0))")
+        # blow past the per-fragment op cap without touching new rows
+        frag = h.index("i").field("f").fragment(0)
+        for c in range(600):
+            frag.set_bit(0, 10_000 + c)
+        assert e.execute("i", "Count(Row(f=0))")[0] > 600
+        merged = e.execute("i", "Row(f=0)")[0].columns
+        for fld in h.index("i").fields.values():
+            if hasattr(fld, "_stacked_cache"):
+                fld._stacked_cache.clear()
+        assert e.execute("i", "Row(f=0)")[0].columns == merged
+
+    def test_unlogged_version_bump_forces_rebuild(self, env):
+        """restore/snapshot paths replace planes and bump version without
+        logging; a later logged write must NOT let the log bridge across
+        that gap (it would serve pre-restore data merged with one op)."""
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        f = idx.field("n")
+        f.set_values([1, 2], [10, 20])
+        assert e.execute("i", "Sum(field=n)")[0].val == 30
+        # external wholesale replacement (as api.restore_tar does)
+        b = f.bsi_fragment(0)
+        b.planes = np.zeros_like(b.planes)
+        b.version += 1
+        f.set_values([3], [5])  # logged write AFTER the unlogged bump
+        assert e.execute("i", "Sum(field=n)")[0].val == 5
+
+    def test_wide_bsi_ops_capped_by_replay_cost(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("n", FieldOptions(type=FieldType.INT))
+        f = idx.field("n")
+        f.set_values(list(range(100)), [1] * 100)
+        assert e.execute("i", "Sum(field=n)")[0].val == 100
+        # wide repeated updates blow the cumulative replay budget -> the
+        # log resets and queries stay correct via rebuild
+        for k in range(5):
+            f.set_values(list(range(2000)), [k] * 2000)
+        assert e.execute("i", "Sum(field=n)")[0].val == 4 * 2000
