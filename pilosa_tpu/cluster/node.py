@@ -64,6 +64,9 @@ class ClusterNode:
             self.all_shards, on_node_down=self._mark_down,
             live_fn=lambda: set(self.disco.live_ids()))
         self.executor._after_write = self._announce_shards_all
+        # Transaction changes sync to peers so an exclusive transaction
+        # on any node excludes cluster-wide (reference: server.go:1082).
+        self.api.transactions.on_change = self._sync_transaction
 
     # -- topology ----------------------------------------------------------
 
@@ -87,6 +90,25 @@ class ClusterNode:
         if write and state != STATE_NORMAL:
             raise ClusterStateError(
                 f"cluster is {state}; writes require NORMAL")
+        if write and self.api.transactions.exclusive_active():
+            # local OR mirrored-from-peer exclusive (backup coordination)
+            from pilosa_tpu.transaction import TransactionError
+
+            raise TransactionError(
+                "an exclusive transaction is active; writes are blocked")
+
+    # -- cluster transactions (reference: transaction.go + server.go:1082) -
+
+    @property
+    def transactions(self):
+        """The HTTP /transaction* endpoints reach the manager through the
+        node (same surface as the plain API)."""
+        return self.api.transactions
+
+    def _sync_transaction(self, action: str, tx) -> None:
+        self.broadcaster.send_sync({
+            "type": B.MSG_TRANSACTION, "action": action,
+            "txn": tx.to_json()})
 
     # -- shard registry ----------------------------------------------------
 
@@ -296,6 +318,10 @@ class ClusterNode:
             with self._lock:
                 self._remote_shards.setdefault(
                     msg["index"], set()).update(msg["shards"])
+            return
+        if t == B.MSG_TRANSACTION:
+            self.api.transactions.apply_remote(
+                msg.get("action", ""), msg.get("txn", {}))
             return
         B.apply_message(self, msg)
 

@@ -90,8 +90,12 @@ class _DeltaLog:
         """Payloads after ``base_version``, or None when the log can't
         bridge from there. ``current_version`` guards against version
         bumps that bypassed the logging write methods (restore/snapshot
-        copies mutate planes and bump version directly)."""
-        if base_version < self.base or current_version > self.head:
+        copies mutate planes and bump version directly). A base *ahead*
+        of the log head is impossible for a live fragment (versions are
+        monotonic) but would mean the stack was built from a different
+        fragment object — silent staleness if treated as "no deltas"."""
+        if (base_version < self.base or base_version > self.head
+                or current_version > self.head):
             return None
         return [p for v, p in self.ops if v > base_version]
 
@@ -189,6 +193,12 @@ class SetFragment:
         else:
             for p in payloads:
                 self.deltas.record(self.version, p, cost=len(p[1]))
+                if self.deltas.base == self.version and not self.deltas.ops:
+                    # record() overflowed and reset mid-loop: the rest of
+                    # this import can never be replayed (base == their
+                    # version), so recording them only burns the fresh
+                    # log's budget
+                    break
         return changed
 
     def clear_column(self, col: int, except_row: Optional[int] = None) -> bool:

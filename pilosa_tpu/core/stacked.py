@@ -193,6 +193,15 @@ def _cache_peek(field, group, subset):
 
 
 def _cache_put(field, group, subset, vers, built):
+    from pilosa_tpu.storage.txn import in_write_qcx
+
+    # Builds performed inside a write Qcx are NOT published: a concurrent
+    # reader's optimistic _cache_get could otherwise observe the write
+    # request's intermediate states (Set(a)Set(b)Count() caching a stack
+    # after only Set(a)). The writer's own later calls rebuild — bounded
+    # to the one request; the post-commit query re-caches normally.
+    if in_write_qcx():
+        return
     with _LOCK:
         cache = getattr(field, "_stacked_cache", None)
         if cache is None:

@@ -16,10 +16,23 @@ unit (the analog of StartAtomicWriteTx, txfactory.go:344).
 
 from __future__ import annotations
 
+import threading
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from pilosa_tpu.core.holder import Holder
+
+_WRITE_CTX = threading.local()
+
+
+def in_write_qcx() -> bool:
+    """True while the calling thread is inside a write Qcx. Stacked-cache
+    publication is suppressed for such threads (core/stacked.py): a
+    multi-call write request like Set(a)Set(b)Count() builds stacks
+    mid-request, and publishing them would let concurrent lock-free
+    readers observe the request's intermediate states — the request-level
+    atomicity the always-Qcx read path used to provide."""
+    return getattr(_WRITE_CTX, "depth", 0) > 0
 
 
 class Qcx:
@@ -38,6 +51,7 @@ class Qcx:
         # and truncate records it never persisted. RLock so nested Qcx
         # (query -> import helpers) is fine.
         self.holder.write_lock.acquire()
+        _WRITE_CTX.depth = getattr(_WRITE_CTX, "depth", 0) + 1
 
     def finish(self) -> None:
         if self._done:
@@ -47,6 +61,7 @@ class Qcx:
             self.holder.flush_wals()
             self.holder.maybe_checkpoint()
         finally:
+            _WRITE_CTX.depth -= 1
             self.holder.write_lock.release()
 
     def __enter__(self) -> "Qcx":

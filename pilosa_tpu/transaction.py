@@ -50,6 +50,37 @@ class TransactionManager:
         self.default_timeout_s = default_timeout_s
         self._lock = threading.Lock()
         self._txs: Dict[str, Transaction] = {}
+        # Cluster sync hook (reference: server.go:1082 — transaction
+        # changes broadcast to peers so exclusive state excludes
+        # cluster-wide). Called AFTER the local change, outside the lock
+        # (the broadcast does HTTP). Set by ClusterNode; None standalone.
+        self.on_change = None
+
+    def _notify(self, action: str, tx: Transaction) -> None:
+        if self.on_change is not None:
+            self.on_change(action, tx)
+
+    def apply_remote(self, action: str, tx_json: dict) -> None:
+        """Mirror a peer's transaction change into the local manager
+        (receive side of the broadcast sync). Never fires on_change —
+        no re-broadcast loops."""
+        with self._lock:
+            if action == "start":
+                self._txs[tx_json["id"]] = Transaction(
+                    id=tx_json["id"],
+                    active=bool(tx_json.get("active")),
+                    exclusive=bool(tx_json.get("exclusive")),
+                    timeout_s=float(tx_json.get("timeout")
+                                    or self.default_timeout_s),
+                    deadline=float(tx_json.get("deadline")
+                                   or time.time() + self.default_timeout_s),
+                )
+            elif action == "finish":
+                self._txs.pop(tx_json.get("id"), None)
+                self._activate_locked()
+            else:
+                raise TransactionError(
+                    f"unknown transaction sync action {action!r}")
 
     def _expire_locked(self) -> None:
         now = time.time()
@@ -92,7 +123,8 @@ class TransactionManager:
                              deadline=time.time() + timeout_s)
             self._txs[tid] = tx
             REGISTRY.count(METRIC_TXN_START)
-            return tx
+        self._notify("start", tx)
+        return tx
 
     def finish(self, tid: str) -> Transaction:
         with self._lock:
@@ -101,7 +133,8 @@ class TransactionManager:
                 raise TransactionError(f"transaction {tid!r} not found")
             REGISTRY.count(METRIC_TXN_END)
             self._expire_locked()  # also activates a now-alone exclusive
-            return tx
+        self._notify("finish", tx)
+        return tx
 
     def get(self, tid: str) -> Transaction:
         with self._lock:

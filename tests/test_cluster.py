@@ -230,3 +230,35 @@ class TestFailover:
                 co.query("si", "Count(Row(f=0))")
         finally:
             c.close()
+
+
+class TestClusterTransactions:
+    def test_exclusive_transaction_blocks_peer_writes(self):
+        """Reference: server.go:1082 — transaction changes broadcast to
+        peers so an exclusive transaction on node A blocks writes on node
+        B (multi-node backup coordination)."""
+        from pilosa_tpu.transaction import TransactionError
+
+        c = LocalCluster(3)
+        try:
+            co = c.coordinator
+            _fill(co, index="ti")
+            tx = c[1].transactions.start(exclusive=True)
+            assert tx.active  # alone -> immediately active
+            # mirrored on every peer
+            assert c[0].transactions.exclusive_active()
+            assert c[2].transactions.exclusive_active()
+            with pytest.raises(TransactionError):
+                co.query("ti", "Set(99, f=1)")
+            with pytest.raises(TransactionError):
+                c[2].import_bits("ti", "f", rows=[1], cols=[99])
+            # a peer can't start another transaction meanwhile
+            with pytest.raises(TransactionError):
+                c[0].transactions.start()
+            # reads still work
+            assert co.query("ti", "Count(Row(f=0))")[0] >= 0
+            c[1].transactions.finish(tx.id)
+            assert not c[0].transactions.exclusive_active()
+            assert co.query("ti", "Set(99, f=1)") == [True]
+        finally:
+            c.close()

@@ -371,6 +371,52 @@ class TestTimeRanges:
         # No range: standard view has everything.
         assert q(e, "i", "Row(t=1)")[0].columns == [1, 2, 3]
 
+    def test_topn_time_range(self, env):
+        """TopN(from, to) must count only the covering quantum views, not
+        the standard view (VERDICT r1-r3 carry-over)."""
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("t", FieldOptions(type=FieldType.TIME,
+                                           time_quantum="YMDH"))
+        # row 1: 3 columns in 2010, 1 in 2011; row 2: 1 in 2010, 2 in 2011
+        q(e, "i", "Set(1, t=1, 2010-02-01T00:00)")
+        q(e, "i", "Set(2, t=1, 2010-03-01T00:00)")
+        q(e, "i", f"Set({SHARD_WIDTH + 5}, t=1, 2010-04-01T00:00)")
+        q(e, "i", "Set(9, t=1, 2011-05-01T00:00)")
+        q(e, "i", "Set(3, t=2, 2010-02-01T00:00)")
+        q(e, "i", "Set(4, t=2, 2011-03-01T00:00)")
+        q(e, "i", "Set(5, t=2, 2011-04-01T00:00)")
+        # per-view oracle for the 2010 range
+        pairs = q(e, "i",
+                  "TopN(t, from='2010-01-01T00:00', to='2011-01-01T00:00')"
+                  )[0].pairs
+        assert [(p.id, p.count) for p in pairs] == [(1, 3), (2, 1)]
+        # 2011 flips the ranking
+        pairs = q(e, "i",
+                  "TopN(t, from='2011-01-01T00:00', to='2012-01-01T00:00')"
+                  )[0].pairs
+        assert [(p.id, p.count) for p in pairs] == [(2, 2), (1, 1)]
+        # no range: standard view counts everything
+        pairs = q(e, "i", "TopN(t)")[0].pairs
+        assert [(p.id, p.count) for p in pairs] == [(1, 4), (2, 3)]
+        # sub-range covering multiple finer views within one year
+        pairs = q(e, "i",
+                  "TopN(t, from='2010-02-01T00:00', to='2010-04-01T00:00')"
+                  )[0].pairs
+        assert [(p.id, p.count) for p in pairs] == [(1, 2), (2, 1)]
+
+    def test_rows_time_range(self, env):
+        h, e = env
+        idx = h.create_index("i")
+        idx.create_field("t", FieldOptions(type=FieldType.TIME,
+                                           time_quantum="YMD"))
+        q(e, "i", "Set(1, t=1, 2010-02-01T00:00)")
+        q(e, "i", "Set(2, t=2, 2011-03-01T00:00)")
+        assert q(e, "i",
+                 "Rows(t, from='2010-01-01T00:00', to='2011-01-01T00:00')"
+                 )[0] == [1]
+        assert q(e, "i", "Rows(t)")[0] == [1, 2]
+
 
 class TestExtract:
     def test_extract(self, env):

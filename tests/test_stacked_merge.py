@@ -218,3 +218,66 @@ class TestOverflow:
         for k in range(5):
             f.set_values(list(range(2000)), [k] * 2000)
         assert e.execute("i", "Sum(field=n)")[0].val == 4 * 2000
+
+
+class TestDeltaLogGuards:
+    def test_since_impossible_base_returns_none(self):
+        """ADVICE r2: a stack base ahead of the log head means the stack
+        was built from a different fragment object — must rebuild, not
+        silently report 'no deltas'."""
+        from pilosa_tpu.core.fragment import _DeltaLog
+
+        log = _DeltaLog()
+        log.record(1, ("p1",))
+        assert log.since(0, 1) == [("p1",)]
+        assert log.since(5, 1) is None  # base > head: impossible bridge
+        assert log.since(0, 2) is None  # current > head: unlogged bump
+
+    def test_set_many_stops_recording_after_midloop_reset(self, monkeypatch):
+        """ADVICE r2: after record() overflows and resets mid-import, the
+        remaining payloads are unreplayable (base == their version) and
+        must not burn the fresh log's budget."""
+        from pilosa_tpu.core import fragment as fragmod
+
+        monkeypatch.setattr(fragmod, "_DELTA_MAX_OPS", 4)
+        frag = fragmod.SetFragment(0)
+        for r in range(8):
+            frag.set_bit(r, 0)  # pre-create rows (new rows reset anyway)
+        frag.deltas.reset(frag.version)
+        # 8 existing rows in one bulk import: records overflow at op 5
+        frag.set_many(list(range(8)), [100 + r for r in range(8)])
+        assert frag.deltas.base == frag.version
+        assert len(frag.deltas.ops) == 0  # nothing recorded post-reset
+        # the NEXT write gets the full fresh budget
+        changed = frag.set_bit(0, 200)
+        assert changed
+        assert len(frag.deltas.ops) == 1
+
+
+class TestWriteQcxIsolation:
+    def test_stack_built_inside_write_qcx_not_published(self):
+        """ADVICE r2 (api.py:107): a stack built mid-write-request must
+        not be published where concurrent lock-free readers could observe
+        the request's intermediate state."""
+        from pilosa_tpu.core.stacked import stacked_set
+        from pilosa_tpu.storage.txn import TxFactory
+
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        f = idx.field("f")
+        f.fragment(0, create=True).set_bit(1, 5)
+        txf = TxFactory(h)
+        with txf.qcx():
+            st = stacked_set(f, [0], "standard")
+            assert st is not None
+            cache = getattr(f, "_stacked_cache", {})
+            assert not any(
+                inner for inner in cache.values()
+            ), "stack published during write Qcx"
+        # outside the Qcx the same build publishes normally
+        st2 = stacked_set(f, [0], "standard")
+        cache = getattr(f, "_stacked_cache", {})
+        assert any(inner for inner in cache.values())
+        # and is served back on the next call
+        assert stacked_set(f, [0], "standard") is st2
