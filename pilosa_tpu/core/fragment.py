@@ -140,8 +140,9 @@ class SetFragment:
 
     def set_bit(self, row: int, col: int) -> bool:
         """Set bit; returns True if it changed (reference: fragment.go
-        setBit's changed flag feeding import counts)."""
-        new_row = row not in self.row_index
+        setBit's changed flag feeding import counts). New rows are
+        representable too — the stacked advance appends a slot in place
+        (core/stacked.py _advance_set; VERDICT r3 #5 streaming ingest)."""
         s = self._slot(row)
         w, b = divmod(col, BITS_PER_WORD)
         mask = np.uint32(1) << np.uint32(b)
@@ -150,10 +151,7 @@ class SetFragment:
             return False
         self.planes[s, w] = old | mask
         self.version += 1
-        if new_row:  # structure change: stacks must rebuild
-            self.deltas.reset(self.version)
-        else:
-            self.deltas.record(self.version, (row, (col,), ()))
+        self.deltas.record(self.version, (row, (col,), ()))
         return True
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -177,7 +175,6 @@ class SetFragment:
         cols = np.asarray(cols, dtype=np.int64)
         if rows.size == 0:
             return 0
-        new_rows = any(int(r) not in self.row_index for r in np.unique(rows))
         changed = 0
         payloads = []
         for row in np.unique(rows):
@@ -188,9 +185,10 @@ class SetFragment:
             changed += int(np.sum(popcount_words(self.planes[s]))) - before
             payloads.append((int(row), tuple(int(c) for c in sel), ()))
         self.version += 1
-        if new_rows or cols.size > _DELTA_MAX_COLS:
+        if cols.size > _DELTA_MAX_COLS:
             self.deltas.reset(self.version)
         else:
+            # new rows are representable (stacked append path)
             for p in payloads:
                 self.deltas.record(self.version, p, cost=len(p[1]))
                 if self.deltas.base == self.version and not self.deltas.ops:

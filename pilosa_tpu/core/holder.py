@@ -109,7 +109,11 @@ class Holder:
         return idx
 
     def delete_index(self, name: str) -> None:
+        from pilosa_tpu.core.stacked import release_field_cache
+
         idx = self.indexes.pop(name)
+        for f in idx.fields.values():  # drop every field's HBM entries
+            release_field_cache(f)
         if idx.wal is not None:
             idx.wal.close()
         # Remove the whole index dir (WAL, checkpoint npz fragments,
@@ -204,17 +208,21 @@ class Holder:
         if op == "delete_view":  # TTL sweep tombstone (server/maintenance)
             f = idx.fields.get(fname)
             if f is not None:
+                from pilosa_tpu.core.stacked import release_field_cache
+
                 f.views.pop(rec[2], None)
-                f._stacked_cache = {}
+                release_field_cache(f)
             return
         if op == "delete_field":
             # tombstone: a field deleted (and possibly re-created) after
             # earlier records were logged — wipe what replay built so far
             f = idx.fields.get(fname)
             if f is not None:
+                from pilosa_tpu.core.stacked import release_field_cache
+
                 f.views.clear()
                 f.bsi.clear()
-                f._stacked_cache = {}
+                release_field_cache(f)
             return
         if op == "delete_cols":  # index-level record, no field name
             _, _, shard, packed = rec

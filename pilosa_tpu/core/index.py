@@ -83,6 +83,9 @@ class Index:
     def delete_field(self, name: str) -> None:
         if name == EXISTENCE_FIELD:
             raise ValueError("cannot delete the existence field")
+        from pilosa_tpu.core.stacked import release_field_cache
+
+        release_field_cache(self.fields[name])  # drop HBM budget entries
         del self.fields[name]
         # Tombstone + checkpoint-file removal so neither WAL replay nor
         # the npz loader resurrects the data into a re-created field of

@@ -1,4 +1,5 @@
-"""Stacked device views: a field's fragments across shards as ONE tensor.
+"""Stacked device views: a field's fragments across shards as ONE tensor,
+paged into row blocks under an HBM budget.
 
 The key TPU-latency insight: every PQL read kernel (popcount reductions,
 BSI compare circuits, pair-count matmuls) reduces over *columns* and never
@@ -13,37 +14,83 @@ difference between per-query latency scaling with shard count (the
 reference's per-shard map loop, executor.go:6742 mapperLocal) and staying
 flat.
 
-Row slots are the sorted union of row IDs across the stacked fragments so
-one slot index addresses the same row in every shard (the reference gets
-this for free from row-major roaring addressing, fragment.go:34-49).
+Row slots are the union of row IDs across the stacked fragments so one
+slot index addresses the same row in every shard (the reference gets this
+for free from row-major roaring addressing, fragment.go:34-49).
+
+**Row-block paging (SURVEY §7 "ragged row counts").** Where roaring adapts
+per container (roaring.go:53-58), dense planes cost ``S*W*4`` bytes per
+row — a 50k-row field over 8 shards is ~50 GB, far beyond HBM. Stacks
+whose full tensor exceeds one block therefore page: slots are chunked
+into fixed-shape ``uint32[block_rows, S*W]`` blocks (one XLA executable
+per shape), each built lazily from the host fragments on first touch and
+LRU-evicted by the global :class:`DeviceBudget`. Full-scan kernels
+(TopN/Rows/GroupBy) stream the blocks; point reads touch one block.
+
+Lazy builds preserve snapshot consistency by *versioning*, not copying: a
+block built after a member fragment changed raises :class:`StackStale`
+and the executor retries the whole (pure, re-executable) read against a
+fresh stack — the paging analog of RBF's page-map snapshot isolation
+(rbf/page_map.go).
 
 Caches are hung on the owning Field keyed by (view, shard tuple) and
 validated against the fragment version vector — a write to any member
-fragment invalidates (the coarse re-upload strategy documented in
-fragment.py; incremental device merge is a later optimization).
+fragment invalidates, with two cheap advance paths instead of a rebuild:
+masked scatters for existing-row bit flips, and in-place slot append for
+new rows (streaming ingest; VERDICT r3 #5).
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu.ops import bitmap as bitops
 from pilosa_tpu.ops import bsi as bsiops
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
 _MIN_SLOTS = 8
 
 
-# Full-stack uploads (host -> device transfers of whole stacked tensors).
-# The incremental write-merge path must NOT bump these — tests assert a
-# setbit between two queries costs a tiny scatter, not a re-upload.
+# Full-stack uploads (host -> device transfers of whole stacked tensors or
+# blocks). The incremental write-merge path must NOT bump these — tests
+# assert a setbit between two queries costs a tiny scatter, not a
+# re-upload.
 UPLOAD_STATS = {"count": 0, "bytes": 0}
+
+# Paged-stack observability: block (re)builds and budget evictions.
+PAGING_STATS = {"block_builds": 0, "evictions": 0, "stale_retries": 0}
+
+
+class StackStale(RuntimeError):
+    """A lazy block build found its member fragments newer than the
+    stack's snapshot version. The read must restart on a fresh stack
+    (executor.execute retries; writes are excluded on the final try)."""
+
+
+_SYNC_PARTS: Optional[bool] = None
+
+
+def sync_part(arr):
+    """On the CPU backend, block on each per-block kernel before the next
+    launches: XLA's in-process CPU collectives can deadlock (and abort
+    via AwaitAndLogIfStuck) when many SPMD programs queue concurrently.
+    Real TPU streams execute programs in order, so block streaming stays
+    fully async there."""
+    global _SYNC_PARTS
+    if _SYNC_PARTS is None:
+        _SYNC_PARTS = jax.devices()[0].platform == "cpu"
+    if _SYNC_PARTS:
+        jax.block_until_ready(arr)
+    return arr
 
 
 def _engine_put(host: np.ndarray) -> jax.Array:
@@ -66,29 +113,203 @@ def _pow2(n: int) -> int:
     return cap
 
 
-class StackedSet:
-    """Union-row view of set fragments: device uint32[Rcap, S*W]."""
+# ---------------------------------------------------------------------------
+# Device-memory budget: LRU over paged blocks (the unbounded dimension).
+# Unpaged stacks stay bounded by the per-group subset LRU below plus the
+# paging threshold itself (an unpaged stack is at most one block large).
+# ---------------------------------------------------------------------------
 
-    def __init__(self, shards: Sequence[int], fragments, words: int = WORDS_PER_SHARD):
+def _env_mb(name: str, default_mb: int) -> int:
+    try:
+        return int(os.environ.get(name, default_mb))
+    except ValueError:
+        return default_mb
+
+
+class DeviceBudget:
+    """Byte-capped LRU of evictable device arrays (paged stack blocks).
+
+    Eviction drops the owner's *reference*; in-flight kernels keep the
+    buffer alive until they finish (XLA buffers are refcounted), so no
+    pinning protocol is needed — an evicted block is simply rebuilt from
+    the host on next touch (the RBF page-cache analog, rbf/db.go mmap)."""
+
+    def __init__(self, cap_bytes: int):
+        self.cap = cap_bytes
+        self.used = 0
+        self._lock = threading.Lock()
+        self._lru: "OrderedDict[Tuple, Tuple[int, object]]" = OrderedDict()
+
+    def charge(self, key: Tuple, nbytes: int, evict_cb) -> None:
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self.used -= old[0]
+            self._lru[key] = (nbytes, evict_cb)
+            self.used += nbytes
+            while self.used > self.cap and len(self._lru) > 1:
+                k, (b, cb) = self._lru.popitem(last=False)
+                if k == key:  # never evict the entry being inserted
+                    self._lru[k] = (b, cb)
+                    self._lru.move_to_end(k, last=False)
+                    if len(self._lru) == 1:
+                        break
+                    continue
+                self.used -= b
+                PAGING_STATS["evictions"] += 1
+                cb()
+
+    def touch(self, key: Tuple) -> None:
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+
+    def release(self, key: Tuple) -> None:
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self.used -= old[0]
+
+
+#: Default HBM budget for paged blocks (v5e has 16 GiB; leave headroom
+#: for unpaged stacks, kernel workspace and XLA constants).
+BUDGET = DeviceBudget(_env_mb("PILOSA_TPU_HBM_BUDGET_MB", 6144) << 20)
+
+#: Target bytes per row block. A stack pages when its full tensor would
+#: exceed one block. Tests override via env to exercise paging cheaply.
+_BLOCK_BYTES = _env_mb("PILOSA_TPU_BLOCK_BYTES_MB", 256) << 20
+
+_stack_serial = itertools.count()
+
+
+class StackedSet:
+    """Union-row view of set fragments: ``uint32[cap, S*W]`` in row blocks.
+
+    Unpaged stacks (cap fits one block) materialize eagerly as a single
+    tensor — the common case and the latency fast path. Paged stacks
+    build blocks lazily and stream them.
+    """
+
+    def __init__(self, shards: Sequence[int], fragments,
+                 words: int = WORDS_PER_SHARD, write_lock=None):
         self.shards = tuple(shards)
         self.words = words
         self.total_words = len(self.shards) * words
+        self.serial = next(_stack_serial)
+        # lazy block builds re-acquire this to exclude writers while
+        # copying live host planes (the same lock stacked_set holds for
+        # the eager build path)
+        self._write_lock = (write_lock if write_lock is not None
+                            else contextlib.nullcontext())
         rows: set = set()
         for frag in fragments:
             if frag is not None:
                 rows.update(frag.row_index)
         self.row_ids: List[int] = sorted(rows)
         self.row_index: Dict[int, int] = {r: i for i, r in enumerate(self.row_ids)}
-        cap = _pow2(len(self.row_ids))
-        host = np.zeros((cap, self.total_words), dtype=np.uint32)
-        for si, frag in enumerate(fragments):
-            if frag is None or not frag.row_ids:
-                continue
-            lo = si * words
-            for slot, row in enumerate(frag.row_ids):
-                host[self.row_index[row], lo:lo + words] = frag.planes[slot]
-        self.planes: jax.Array = _engine_put(host)
+        row_bytes = self.total_words * 4
+        per_block = max(_MIN_SLOTS, _BLOCK_BYTES // max(row_bytes, 1))
+        self.block_rows = min(_pow2(len(self.row_ids)),
+                              _pow2(per_block) // 2 or _MIN_SLOTS)
+        if self.block_rows * row_bytes > _BLOCK_BYTES:
+            self.block_rows = max(_MIN_SLOTS, self.block_rows // 2)
+        self.cap = max(self.block_rows,
+                       -(-len(self.row_ids) // self.block_rows)
+                       * self.block_rows)
+        self.paged = self.cap > self.block_rows
+        # snapshot context for lazy builds + advance
+        self._fragments = list(fragments)
+        self._built_vers = tuple(
+            -1 if f is None else f.version for f in fragments)
+        self._blocks: List[Optional[jax.Array]] = (
+            [None] * (self.cap // self.block_rows))
+        self._lock = threading.Lock()
         self._zero: Optional[jax.Array] = None
+        # request-scoped stacks (built inside a write Qcx, never
+        # published to the field cache) opt out of budget accounting —
+        # they die with the request, and LRU entries would orphan
+        self.ephemeral = False
+        if not self.paged:
+            self._blocks[0] = self._build_block_host(0)
+
+    # -- block machinery ----------------------------------------------------
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._blocks)
+
+    def _build_block_host(self, bi: int) -> jax.Array:
+        """Assemble block ``bi`` from the host fragment planes and upload.
+        Caller must have validated the version snapshot (or hold the
+        writer lock through the build, as __init__/advance do)."""
+        lo_slot = bi * self.block_rows
+        hi_slot = min(lo_slot + self.block_rows, len(self.row_ids))
+        host = np.zeros((self.block_rows, self.total_words), dtype=np.uint32)
+        for si, frag in enumerate(self._fragments):
+            if frag is None:
+                continue
+            lo = si * self.words
+            for slot in range(lo_slot, hi_slot):
+                fslot = frag.row_index.get(self.row_ids[slot])
+                if fslot is not None:
+                    host[slot - lo_slot, lo:lo + self.words] = \
+                        frag.planes[fslot]
+        PAGING_STATS["block_builds"] += 1
+        return _engine_put(host)
+
+    def _ensure_block(self, bi: int) -> jax.Array:
+        blk = self._blocks[bi]
+        if blk is not None:
+            BUDGET.touch((self.serial, bi))
+            return blk
+        # The writer lock (not just the stack lock) spans the version
+        # check AND the host copy: checking versions without excluding
+        # writers would let a bulk import that mutates planes before its
+        # single version bump produce a torn block.
+        with self._write_lock, self._lock:
+            blk = self._blocks[bi]
+            if blk is not None:
+                return blk
+            for frag, built_v in zip(self._fragments, self._built_vers):
+                if (frag.version if frag is not None else -1) != built_v:
+                    PAGING_STATS["stale_retries"] += 1
+                    raise StackStale(
+                        "fragment advanced past the stack snapshot")
+            blk = self._build_block_host(bi)
+            self._blocks[bi] = blk
+        if self.paged and not self.ephemeral:
+            BUDGET.charge((self.serial, bi), blk.nbytes,
+                          lambda s=self, i=bi: s._drop_block(i))
+        return blk
+
+    def release_device(self) -> None:
+        """Drop this stack's budget entries (called when it leaves the
+        field cache — replaced, LRU-popped, or cleared wholesale). Block
+        arrays still referenced by in-flight reads stay alive via GC."""
+        for bi in range(self.n_blocks):
+            BUDGET.release((self.serial, bi))
+
+    def _drop_block(self, bi: int) -> None:
+        # unpaged stacks are never registered with the budget
+        self._blocks[bi] = None
+
+    def iter_blocks(self) -> Iterator[Tuple[int, jax.Array]]:
+        """(start_slot, device block) over all blocks, built on demand."""
+        for bi in range(self.n_blocks):
+            yield bi * self.block_rows, self._ensure_block(bi)
+
+    # -- single-tensor view (unpaged fast path) -------------------------------
+
+    @property
+    def planes(self) -> jax.Array:
+        """The full ``[cap, S*W]`` tensor. Only unpaged stacks have one —
+        paged consumers must stream ``iter_blocks()``/``row_counts()``."""
+        if self.paged:
+            raise AssertionError(
+                "paged stack has no single tensor; use iter_blocks()")
+        return self._ensure_block(0)
+
+    # -- reads ----------------------------------------------------------------
 
     def zero_plane(self) -> jax.Array:
         if self._zero is None:
@@ -96,26 +317,74 @@ class StackedSet:
         return self._zero
 
     def row_plane(self, row: int) -> jax.Array:
-        """Device [S*W] plane for one row id (zeros when absent)."""
+        """Device [S*W] plane for one row id (zeros when absent). Point
+        reads touch exactly one block."""
         slot = self.row_index.get(row)
         if slot is None:
             return self.zero_plane()
-        return self.planes[slot]
+        blk = self._ensure_block(slot // self.block_rows)
+        return blk[slot % self.block_rows]
+
+    def take_rows(self, rows: Sequence[int]) -> jax.Array:
+        """Device ``[len(rows), S*W]`` gather of the given row ids (zero
+        planes for absent rows), assembled block-locally."""
+        n = len(rows)
+        out_parts: List[Tuple[np.ndarray, jax.Array]] = []
+        by_block: Dict[int, Tuple[List[int], List[int]]] = {}
+        missing: List[int] = []
+        for i, r in enumerate(rows):
+            slot = self.row_index.get(r)
+            if slot is None:
+                missing.append(i)
+                continue
+            dst, src = by_block.setdefault(slot // self.block_rows, ([], []))
+            dst.append(i)
+            src.append(slot % self.block_rows)
+        if len(by_block) == 1 and not missing:
+            bi, (dst, src) = next(iter(by_block.items()))
+            blk = self._ensure_block(bi)
+            order = np.argsort(dst)
+            return jnp.take(blk, jnp.asarray(np.asarray(src)[order]), axis=0)
+        out = jnp.zeros((n, self.total_words), dtype=jnp.uint32)
+        for bi, (dst, src) in by_block.items():
+            blk = self._ensure_block(bi)
+            sel = jnp.take(blk, jnp.asarray(src, dtype=jnp.int32), axis=0)
+            out = out.at[jnp.asarray(dst, dtype=jnp.int32)].set(sel)
+        return out
 
     def rows_plane(self, rows: Sequence[int]) -> jax.Array:
-        """OR of several rows' planes (UnionRows)."""
-        slots = [self.row_index[r] for r in rows if r in self.row_index]
-        if not slots:
+        """OR of several rows' planes (UnionRows), streamed per block."""
+        by_block: Dict[int, List[int]] = {}
+        for r in rows:
+            slot = self.row_index.get(r)
+            if slot is not None:
+                by_block.setdefault(slot // self.block_rows, []).append(
+                    slot % self.block_rows)
+        if not by_block:
             return self.zero_plane()
-        sel = self.planes[jnp.asarray(slots)]
-        return jax.lax.reduce(
-            sel, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0,))
+        acc = None
+        for bi, slots in sorted(by_block.items()):
+            blk = self._ensure_block(bi)
+            sel = jnp.take(blk, jnp.asarray(slots, dtype=jnp.int32), axis=0)
+            part = jax.lax.reduce(
+                sel, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0,))
+            acc = part if acc is None else jnp.bitwise_or(acc, part)
+            sync_part(acc)
+        return acc
+
+    def row_counts(self, filt: Optional[jax.Array] = None) -> jax.Array:
+        """Device ``[cap]`` per-slot popcounts (optionally filtered),
+        streamed per block (reference: fragment.go:1317 top counts)."""
+        parts = [sync_part(bitops.row_counts(blk, filt))
+                 for _, blk in self.iter_blocks()]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
 class StackedBSI:
     """BSI plane stacks across shards: device uint32[2+depth, S*W].
 
-    Shards with shallower bit depth than the widest member are zero-padded
+    Bit depth is bounded (<= 2 + 64 planes), so BSI stacks never page;
+    shards with shallower depth than the widest member are zero-padded
     (a zero magnitude plane contributes nothing to compares or sums).
     """
 
@@ -201,26 +470,62 @@ def _cache_put(field, group, subset, vers, built):
     # after only Set(a)). The writer's own later calls rebuild — bounded
     # to the one request; the post-commit query re-caches normally.
     if in_write_qcx():
+        # the stack is request-scoped: drop any budget entries its build
+        # or advance already charged and stop future lazy-block charges
+        # (otherwise the orphaned LRU entries pin device arrays and
+        # evict genuinely cached blocks)
+        release = getattr(built, "release_device", None)
+        if release is not None:
+            built.ephemeral = True
+            release()
         return
+    dropped = []
     with _LOCK:
         cache = getattr(field, "_stacked_cache", None)
         if cache is None:
             cache = field._stacked_cache = {}
         inner = cache.setdefault(group, OrderedDict())
+        old = inner.get(subset)
+        if old is not None and old[1] is not built:
+            dropped.append(old[1])
         inner[subset] = (vers, built)
         inner.move_to_end(subset)
         while len(inner) > _MAX_SUBSETS_PER_GROUP:
-            inner.popitem(last=False)
+            dropped.append(inner.popitem(last=False)[1][1])
+    # budget entries of stacks leaving the cache are released (outside
+    # the cache lock; BUDGET has its own)
+    for stack in dropped:
+        release = getattr(stack, "release_device", None)
+        if release is not None:
+            release()
+
+
+def release_field_cache(field) -> None:
+    """Clear a field's stacked cache AND the budget entries of every
+    resident stack (holder restore / mesh switch / delete paths)."""
+    with _LOCK:
+        cache = getattr(field, "_stacked_cache", None)
+        field._stacked_cache = {}
+    if not cache:
+        return
+    for inner in cache.values():
+        for _, stack in inner.values():
+            release = getattr(stack, "release_device", None)
+            if release is not None:
+                release()
 
 
 # ---------------------------------------------------------------------------
 # Incremental write-merge (VERDICT r1 #5; SURVEY §7 "Mutability on device").
 # A write between two queries used to invalidate the whole stacked tensor
-# and re-upload it. Instead, representable writes (existing rows only, no
-# structure change — fragment.py _DeltaLog) advance the cached device
-# tensor in place: the pending ops collapse host-side into final
-# per-(slot, fused-word) OR/ANDNOT masks (ordered, so set-then-clear of a
-# bit resolves correctly), and ONE jitted scatter applies them on device.
+# and re-upload it. Instead, representable writes (fragment.py _DeltaLog)
+# advance the cached device tensor in place:
+#   - bit flips on existing rows collapse host-side into final per-(slot,
+#     fused-word) OR/ANDNOT masks (ordered, so set-then-clear resolves
+#     correctly) and ONE jitted scatter per touched block applies them;
+#   - writes to NEW rows append slots in place (streaming ingest of new
+#     rows — VERDICT r3 #5): unpaged stacks grow device-side by padding
+#     (no host re-upload), paged stacks just extend the lazy block list.
 # Transfer cost: a few hundred bytes of indices+masks, not the stack.
 # ---------------------------------------------------------------------------
 
@@ -235,6 +540,16 @@ def _cache_put(field, group, subset, vers, built):
 def _apply_bit_deltas(planes, slots, words, orm, anm):
     cur = planes[slots, words]  # pads clamp-read; their writes are dropped
     return planes.at[slots, words].set((cur & ~anm) | orm, mode="drop")
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("new_rows",))
+def _grow_rows_device(planes, new_rows: int):
+    """Zero-pad a block/stack with ``new_rows`` extra slots on device —
+    an HBM-side copy, no host transfer."""
+    return jnp.pad(planes, ((0, new_rows), (0, 0)))
 
 
 class _MaskAccum:
@@ -255,8 +570,15 @@ class _MaskAccum:
         e[1] |= m
         e[0] &= ~m
 
-    def apply(self, planes: jax.Array) -> jax.Array:
-        keys = list(self.masks)
+    def apply(self, planes: jax.Array, lo_slot: int = 0,
+              hi_slot: Optional[int] = None) -> jax.Array:
+        """Scatter the accumulated masks whose slot falls in
+        [lo_slot, hi_slot) onto ``planes`` (slot-rebased by lo_slot)."""
+        if hi_slot is None:
+            hi_slot = lo_slot + planes.shape[0]
+        keys = [k for k in self.masks if lo_slot <= k[0] < hi_slot]
+        if not keys:
+            return planes
         cap = _pow2(len(keys))
         slots = np.zeros(cap, dtype=np.int32)
         # pads point past the word axis: dropped by the scatter
@@ -264,16 +586,35 @@ class _MaskAccum:
         orm = np.zeros(cap, dtype=np.uint32)
         anm = np.zeros(cap, dtype=np.uint32)
         for i, k in enumerate(keys):
-            slots[i], words[i] = k
+            slots[i] = k[0] - lo_slot
+            words[i] = k[1]
             orm[i], anm[i] = self.masks[k]
         return _apply_bit_deltas(planes, slots, words, orm, anm)
 
 
 def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["StackedSet"]:
-    """Replay pending writes onto a cached StackedSet; None -> rebuild."""
+    """Replay pending writes onto a cached StackedSet; None -> rebuild.
+    Caller holds the writer lock (fragment versions are quiescent)."""
     from pilosa_tpu.shardwidth import BITS_PER_WORD
 
     acc = _MaskAccum()
+    new_rows: List[int] = []
+    new_index: Optional[Dict[int, int]] = None
+
+    def slot_of(row: int) -> int:
+        nonlocal new_index
+        s = stack.row_index.get(row)
+        if s is None and new_index is not None:
+            s = new_index.get(row)
+        if s is None:
+            # appended row: assign the next slot in place (VERDICT r3 #5)
+            if new_index is None:
+                new_index = {}
+            s = len(stack.row_ids) + len(new_rows)
+            new_rows.append(row)
+            new_index[row] = s
+        return s
+
     for si, (frag, built_v) in enumerate(zip(fragments, built_vers)):
         if frag is None:
             if built_v != -1:
@@ -288,25 +629,74 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
             return None
         lo = si * stack.words
         for row, set_cols, clear_cols in ops:
-            slot = stack.row_index.get(row)
-            if slot is None:
-                return None  # write touched a row the stack never saw
+            slot = slot_of(row)
             for col in set_cols:
                 w, b = divmod(col, BITS_PER_WORD)
                 acc.set(slot, lo + w, b)
             for col in clear_cols:
                 w, b = divmod(col, BITS_PER_WORD)
                 acc.clear(slot, lo + w, b)
-    if not acc.masks:
+    if not acc.masks and not new_rows:
         return stack  # versions moved with no net representable delta
     new = StackedSet.__new__(StackedSet)
     new.shards = stack.shards
     new.words = stack.words
     new.total_words = stack.total_words
-    new.row_ids = stack.row_ids
-    new.row_index = stack.row_index
-    new.planes = acc.apply(stack.planes)
+    new.serial = next(_stack_serial)
+    new.block_rows = stack.block_rows
+    new._lock = threading.Lock()
+    new._write_lock = stack._write_lock
     new._zero = None
+    new.ephemeral = False
+    new._fragments = list(fragments)
+    new._built_vers = tuple(
+        -1 if f is None else f.version for f in fragments)
+    if new_rows:
+        new.row_ids = stack.row_ids + new_rows
+        new.row_index = dict(stack.row_index)
+        new.row_index.update(new_index)
+    else:
+        new.row_ids = stack.row_ids
+        new.row_index = stack.row_index
+    if not stack.paged:
+        # grow the single block in place (device-side zero pad, pow2
+        # capacities so XLA sees few shapes); outgrowing one block means
+        # the stack must be rebuilt in paged form
+        row_bytes = stack.total_words * 4
+        need = _pow2(len(new.row_ids))
+        if need * row_bytes > _BLOCK_BYTES:
+            return None
+        new.block_rows = max(stack.block_rows, need)
+        new.cap = new.block_rows
+        new.paged = False
+        blk = stack._blocks[0]
+        if new.cap > stack.cap:
+            blk = _grow_rows_device(blk, new.cap - stack.cap)
+        new._blocks = [acc.apply(blk, 0, new.cap)]
+        return new
+    # paged: block_rows is fixed; appends extend the lazy block list.
+    # Scatter the masks into each *materialized* block; unmaterialized
+    # blocks need no replay (their lazy build reads the new host state,
+    # which is consistent with new._built_vers).
+    need_cap = max(stack.cap,
+                   -(-len(new.row_ids) // stack.block_rows)
+                   * stack.block_rows)
+    new.cap = need_cap
+    new.paged = True
+    blocks = list(stack._blocks)
+    blocks.extend([None] * (new.cap // new.block_rows - len(blocks)))
+    for bi, blk in enumerate(blocks):
+        if blk is None:
+            continue
+        lo_slot = bi * new.block_rows
+        blocks[bi] = acc.apply(blk, lo_slot, lo_slot + new.block_rows)
+    # _blocks must exist before any charge: an eviction cascade can pop
+    # one of new's OWN earlier entries, whose callback reads _blocks
+    new._blocks = blocks
+    for bi, blk in enumerate(blocks):
+        if blk is not None:
+            BUDGET.charge((new.serial, bi), blk.nbytes,
+                          lambda s=new, i=bi: s._drop_block(i))
     return new
 
 
@@ -397,7 +787,8 @@ def stacked_set(field, shards: Sequence[int], view: str) -> StackedSet:
             hit = _advance_or_rebuild(
                 field, group, subset, vers, fragments,
                 advance=_advance_set,
-                rebuild=lambda: StackedSet(shards, fragments))
+                rebuild=lambda: StackedSet(
+                    shards, fragments, write_lock=_writer_lock(field)))
     return hit
 
 

@@ -33,6 +33,9 @@ METRIC_TXN_BLOCKED = "transaction_blocked"
 METRIC_EXCLUSIVE_TXN_REQUEST = "transaction_exclusive_request"
 METRIC_EXCLUSIVE_TXN_ACTIVE = "transaction_exclusive_active"
 METRIC_DELETE_DATAFRAME = "delete_dataframe"
+# a stacked tensor could not shard over the engine mesh and fell back to
+# single-device placement (misconfigured mesh loses all parallelism)
+METRIC_MESH_FALLBACK = "mesh_sharding_fallback_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -65,8 +65,11 @@ def remove_expired_views(holder: Holder, now: Optional[dt.datetime] = None
                 for view in list(field.views):
                     end = _view_end(view)
                     if end is not None and end < cutoff:
+                        from pilosa_tpu.core.stacked import \
+                            release_field_cache
+
                         del field.views[view]
-                        field._stacked_cache = {}
+                        release_field_cache(field)
                         if field.wal is not None:
                             field.wal.append(
                                 ("delete_view", field.name, view))
