@@ -142,7 +142,8 @@ class API:
     # -- bulk import (reference: api.go:1438 Import / ImportValue) ---------
 
     def import_bits(self, index: str, field: str,
-                    rows: Sequence[int], cols: Sequence[int],
+                    rows: Sequence[int] = (),
+                    cols: Optional[Sequence[int]] = None,
                     row_keys: Optional[Sequence[str]] = None,
                     col_keys: Optional[Sequence[str]] = None,
                     clear: bool = False, remote: bool = False) -> int:
@@ -171,7 +172,8 @@ class API:
         return changed
 
     def import_values(self, index: str, field: str,
-                      cols: Sequence[int], values: Sequence,
+                      cols: Optional[Sequence[int]] = None,
+                      values: Sequence = (),
                       col_keys: Optional[Sequence[str]] = None,
                       remote: bool = False) -> int:
         """Bulk BSI import (reference: api.go ImportValue ->

@@ -203,9 +203,22 @@ class ClusterNode:
         return [result_to_wire(r) for r in results]
 
     # The SQL engine plans against this node's surface, so PQL pushdowns
-    # ride the cluster executor (self.executor). Same lazy-init as the
-    # single-node path — share the one implementation.
+    # ride the cluster executor (self.executor) and DML routes through
+    # this node's import methods (shard owners + replicas). Same
+    # lazy-init as the single-node path — share the one implementation.
     sql = API.sql
+
+    @property
+    def history(self):
+        return self.api.history
+
+    @property
+    def txf(self):
+        """DML group-commit context: local holder's write lock + WAL
+        flush. Remote writes commit per-import on their owners — SQL
+        statement atomicity is node-local, as in the reference (sql3
+        inserts fan imports out without a cluster transaction)."""
+        return self.api.txf
 
     # -- imports (reference: api.go:1438 Import / :618 ImportRoaring) ------
 

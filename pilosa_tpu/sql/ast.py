@@ -101,6 +101,7 @@ class SelectStatement:
     items: List[SelectItem]
     table: Optional[str] = None
     table_alias: Optional[str] = None
+    joins: List["JoinClause"] = dataclasses.field(default_factory=list)
     where: Optional[Expr] = None
     group_by: List[Expr] = dataclasses.field(default_factory=list)
     having: Optional[Expr] = None
@@ -109,6 +110,16 @@ class SelectStatement:
     offset: Optional[int] = None
     distinct: bool = False
     top: Optional[int] = None
+
+
+@dataclasses.dataclass
+class JoinClause:
+    """One JOIN term (reference: sql3/parser ast.go JoinOperator +
+    OnConstraint; sources form a left-deep chain here)."""
+    table: str
+    alias: Optional[str] = None
+    on: Optional[Expr] = None
+    kind: str = "INNER"  # INNER | LEFT
 
 
 @dataclasses.dataclass

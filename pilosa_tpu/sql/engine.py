@@ -19,8 +19,8 @@ from pilosa_tpu.sql.lexer import SQLError
 from pilosa_tpu.sql.parser import parse_statement
 from pilosa_tpu.sql.plan import PlanOp, Schema, StaticOp, eval_expr
 from pilosa_tpu.sql.planner import Planner
-from pilosa_tpu.sql.types import column_to_field_options, field_to_sql_type, \
-    id_sql_type
+from pilosa_tpu.sql.types import column_to_field_options, \
+    column_to_options_dict, field_to_sql_type, id_sql_type
 
 
 @dataclasses.dataclass
@@ -109,8 +109,10 @@ class SQLEngine:
             for c in ct.columns:
                 if c.name == "_id":
                     continue
-                opts = column_to_field_options(c)
-                self.api.holder.index(ct.name).create_field(c.name, opts)
+                # through the api surface so cluster nodes broadcast the
+                # schema change to peers (node.create_field)
+                self.api.create_field(ct.name, c.name,
+                                      column_to_options_dict(c))
         except Exception:
             self.api.delete_index(ct.name)
             raise
@@ -126,12 +128,12 @@ class SQLEngine:
         return SQLResult(schema=[], data=[])
 
     def _alter_table(self, a: ast.AlterTable) -> SQLResult:
-        idx = self.api.holder.index(a.name)
+        self.api.holder.index(a.name)  # existence check
         if a.add is not None:
-            idx.create_field(a.add.name, column_to_field_options(a.add))
+            self.api.create_field(a.name, a.add.name,
+                                  column_to_options_dict(a.add))
         elif a.drop is not None:
-            with self.api.txf.qcx():  # flushes the delete_field tombstone
-                idx.delete_field(a.drop)
+            self.api.delete_field(a.name, a.drop)
         self.api.holder.save_schema()
         return SQLResult(schema=[], data=[])
 
@@ -155,33 +157,62 @@ class SQLEngine:
         return SQLResult(schema=[], data=[], changed=n)
 
     def _upsert_record(self, idx, values: dict, replace: bool = False) -> None:
-        ex = self.api.executor
-        col = ex._col_id(idx, values["_id"], create=True)
-        idx.add_exists(col)
-        for name, v in values.items():
-            if name == "_id":
-                continue
+        """Write one record THROUGH the api import surface so DML routes
+        to shard owners + replicas on a cluster node (node.import_bits /
+        import_values) and works identically on a single-node API
+        (reference: sql3 insert lowering to the Importer, importer.go:13).
+        """
+        index = idx.name
+        raw_id = values["_id"]
+        col_keys = [str(raw_id)] if idx.options.keys else None
+        cols = None if idx.options.keys else [int(raw_id)]
+
+        def one_col(n: int):
+            return (dict(col_keys=col_keys * n) if col_keys
+                    else dict(cols=cols * n))
+
+        set_fields = [(n, v) for n, v in values.items()
+                      if n != "_id" and v is not None]
+        if not set_fields:
+            # the record exists even when every field is NULL
+            self.api.import_bits(index, "_exists", rows=[0], **one_col(1))
+            return
+        for name, v in set_fields:
             field = idx.field(name)
             t = field.options.type
-            if v is None:
-                continue
             if t.is_bsi:
-                field.set_value(col, v)
-            elif t == FieldType.BOOL:
-                field.set_bool(col, bool(v))
+                self.api.import_values(index, name, values=[v],
+                                       **({"col_keys": col_keys}
+                                          if col_keys else {"cols": cols}))
+                continue
+            if t == FieldType.BOOL:
+                self.api.import_bits(index, name,
+                                     rows=[1 if v else 0], **one_col(1))
+                continue
+            vals = v if isinstance(v, list) else [v]
+            if replace and t not in (FieldType.MUTEX, FieldType.BOOL):
+                # REPLACE resets set-valued columns (reference: sql3
+                # REPLACE INTO); the point Rows lookup + clear import both
+                # ride the api surface, so it is cluster-routed too
+                ident = repr(str(raw_id)) if idx.options.keys else int(raw_id)
+                existing = self.api.query(
+                    index, f"Rows({name}, column={ident})")[0]
+                if existing:
+                    self.api.import_bits(
+                        index, name,
+                        rows=[r for r in existing] if not field.options.keys
+                        else [],
+                        row_keys=([str(r) for r in existing]
+                                  if field.options.keys else None),
+                        clear=True, **one_col(len(existing)))
+            if field.options.keys:
+                self.api.import_bits(index, name, rows=[],
+                                     row_keys=[str(i) for i in vals],
+                                     **one_col(len(vals)))
             else:
-                vals = v if isinstance(v, list) else [v]
-                if replace and t not in (FieldType.MUTEX, FieldType.BOOL):
-                    # REPLACE resets set-valued columns; mutex/bool clear
-                    # themselves in set_bit (reference: sql3 REPLACE INTO).
-                    shard, pos = divmod(col, _shard_width())
-                    for frags in field.views.values():
-                        frag = frags.get(shard)
-                        if frag is not None:
-                            frag.clear_column(pos)
-                for item in vals:
-                    row = ex._row_id(field, item, create=True)
-                    field.set_bit(row, col)
+                self.api.import_bits(index, name,
+                                     rows=[int(i) for i in vals],
+                                     **one_col(len(vals)))
 
     def _bulk_insert(self, bi: ast.BulkInsert) -> SQLResult:
         """CSV bulk load (reference: sql3 BULK INSERT with MAP ordinals,

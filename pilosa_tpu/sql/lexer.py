@@ -23,6 +23,7 @@ KEYWORDS = {
     "WITH", "SHOW", "TABLES", "COLUMNS", "DATABASES", "DELETE",
     "MIN", "MAX", "TIMEUNIT", "TIMEQUANTUM", "TTL", "CACHETYPE", "SIZE",
     "COMMENT", "KEYPARTITIONS", "EXTRACT", "CAST",
+    "JOIN", "INNER", "LEFT", "OUTER", "ON",
 }
 
 # multi-char operators first

@@ -113,6 +113,23 @@ class Parser:
                 s.table_alias = self.ident()
             elif self.peek().kind == "IDENT":
                 s.table_alias = self.ident()
+            # left-deep JOIN chain (reference: sql3/parser source joins)
+            while self.at_kw("JOIN", "INNER", "LEFT"):
+                kind = "INNER"
+                if self.accept_kw("LEFT"):
+                    self.accept_kw("OUTER")
+                    kind = "LEFT"
+                else:
+                    self.accept_kw("INNER")
+                self.expect_kw("JOIN")
+                j = ast.JoinClause(table=self.ident(), kind=kind)
+                if self.accept_kw("AS"):
+                    j.alias = self.ident()
+                elif self.peek().kind == "IDENT":
+                    j.alias = self.ident()
+                self.expect_kw("ON")
+                j.on = self.expr()
+                s.joins.append(j)
         if self.accept_kw("WHERE"):
             s.where = self.expr()
         if self.accept_kw("GROUP"):

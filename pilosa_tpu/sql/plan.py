@@ -89,6 +89,11 @@ def eval_expr(expr: ast.Expr, env: Dict[str, Any]) -> Any:
     if isinstance(expr, ast.Literal):
         return expr.value
     if isinstance(expr, ast.ColumnRef):
+        if expr.table is not None:
+            key = f"{expr.table}.{expr.name}"
+            if key not in env:
+                raise SQLError(f"unknown column {key!r}")
+            return env[key]
         if expr.name not in env:
             raise SQLError(f"unknown column {expr.name!r}")
         return env[expr.name]
@@ -290,6 +295,72 @@ class DistinctOp(PlanOp):
             if key not in seen:
                 seen.add(key)
                 yield row
+
+
+class AliasOp(PlanOp):
+    """Qualify a scan's schema names with a table alias ('a.col') so
+    joined streams have unambiguous env keys."""
+
+    def __init__(self, child: PlanOp, alias: str):
+        self.child = child
+        self.schema = [(f"{alias}.{n}", t) for n, t in child.schema]
+
+    def child_ops(self):
+        return [self.child]
+
+    def rows(self) -> Iterator[Row]:
+        return self.child.rows()
+
+
+class JoinOp(PlanOp):
+    """Hash equi-join of two row streams (reference:
+    sql3/planner/opnestedloops.go — the reference nest-loops; a hash
+    build over the equi keys is strictly better on the same host rows).
+
+    ``equi`` pairs (left column, right column) drive the hash build;
+    ``residual`` is the non-equi remainder of the ON condition, evaluated
+    per candidate pair. LEFT joins emit unmatched left rows null-padded
+    (standard semantics)."""
+
+    def __init__(self, left: PlanOp, right: PlanOp,
+                 equi: List[Tuple[str, str]],
+                 residual: Optional[ast.Expr], kind: str = "INNER"):
+        self.left, self.right = left, right
+        self._equi = equi
+        self._residual = residual
+        self._kind = kind
+        self.schema = left.schema + right.schema
+
+    def child_ops(self):
+        return [self.left, self.right]
+
+    def rows(self) -> Iterator[Row]:
+        lnames = [n for n, _ in self.left.schema]
+        rnames = [n for n, _ in self.right.schema]
+        lkeys = [lnames.index(lc) for lc, _ in self._equi]
+        rkeys = [rnames.index(rc) for _, rc in self._equi]
+        # build side: right (probe left in order, preserving left order)
+        table: Dict[tuple, List[Row]] = {}
+        for row in self.right.rows():
+            key = tuple(_hashable(row[i]) for i in rkeys)
+            if any(k is None for k in key):
+                continue  # NULL never equi-matches
+            table.setdefault(key, []).append(row)
+        null_right = [None] * len(rnames)
+        for lrow in self.left.rows():
+            key = tuple(_hashable(lrow[i]) for i in lkeys)
+            matched = False
+            for rrow in table.get(key, ()) if not any(
+                    k is None for k in key) else ():
+                if self._residual is not None:
+                    env = dict(zip(lnames, lrow))
+                    env.update(zip(rnames, rrow))
+                    if not _truthy(eval_expr(self._residual, env) or False):
+                        continue
+                matched = True
+                yield lrow + rrow
+            if not matched and self._kind == "LEFT":
+                yield lrow + null_right
 
 
 class GroupByOp(PlanOp):

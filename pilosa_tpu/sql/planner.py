@@ -53,6 +53,9 @@ class Planner:
     def plan_select(self, s: ast.SelectStatement) -> PlanOp:
         if s.table is None:
             return self._select_no_table(s)
+        if s.joins:
+            return self._plan_join_select(s)
+        s = _strip_single_table_quals(s)
         ctx = _QueryCtx()
         idx = self.api.holder.index(s.table)
         items = self._expand_star(idx, s.items)
@@ -599,6 +602,230 @@ class Planner:
 
         return CallbackOp(schema, thunk, name="PQLGroupBy")
 
+    # -- JOIN ------------------------------------------------------------------
+
+    def _plan_join_select(self, s: ast.SelectStatement) -> PlanOp:
+        """SELECT over a left-deep JOIN chain (reference:
+        sql3/planner/executionplanner.go compileSource join handling +
+        opnestedloops.go; here: per-table PQL-filtered scans feeding a
+        host hash join, single-table WHERE conjuncts pushed below the
+        join as in planoptimizer.go)."""
+        tables: List[Tuple[str, str]] = [
+            (s.table_alias or s.table, s.table)]
+        tables += [(j.alias or j.table, j.table) for j in s.joins]
+        aliases = [a for a, _ in tables]
+        if len(set(aliases)) != len(aliases):
+            raise SQLError("duplicate table alias in FROM/JOIN")
+        idxs: Dict[str, Index] = {
+            a: self.api.holder.index(t) for a, t in tables}
+        cols: Dict[str, set] = {
+            a: {"_id"} | {f.name for f in idxs[a].public_fields()}
+            for a in aliases}
+        # a qualifier may be the alias or (when still unambiguous) the
+        # table's own name, as in `sum(orders.price) ... from orders o`
+        by_name: Dict[str, str] = {}
+        for a, t in tables:
+            by_name.setdefault(t, a)
+
+        def resolve(ref: ast.ColumnRef) -> str:
+            """Owning alias of a column ref; validates ambiguity."""
+            if ref.table is not None:
+                a = ref.table if ref.table in idxs else by_name.get(ref.table)
+                if a is None:
+                    raise SQLError(f"unknown table alias {ref.table!r}")
+                if ref.name not in cols[a]:
+                    raise SQLError(f"unknown column {a}.{ref.name}")
+                return a
+            owners = [a for a in aliases if ref.name in cols[a]]
+            if not owners:
+                raise SQLError(f"unknown column {ref.name!r}")
+            if len(owners) > 1:
+                raise SQLError(f"ambiguous column {ref.name!r}")
+            return owners[0]
+
+        def qualify(e: ast.Expr) -> ast.Expr:
+            return _map_refs(
+                e, lambda r: ast.ColumnRef(r.name, table=resolve(r)))
+
+        # star expansion over every joined table
+        items: List[ast.SelectItem] = []
+        for it in s.items:
+            if isinstance(it.expr, ast.Star):
+                for a in aliases:
+                    items.append(ast.SelectItem(
+                        ast.ColumnRef("_id", table=a)))
+                    for f in idxs[a].public_fields():
+                        items.append(ast.SelectItem(
+                            ast.ColumnRef(f.name, table=a)))
+            else:
+                items.append(ast.SelectItem(qualify(it.expr), it.alias))
+        ons = [qualify(j.on) for j in s.joins]
+        where = qualify(s.where) if s.where is not None else None
+        group_by = [qualify(g) for g in s.group_by]
+        having = qualify(s.having) if s.having is not None else None
+        order_by = [ast.OrderTerm(qualify(t.expr), t.desc)
+                    for t in s.order_by]
+
+        # split WHERE: single-table conjuncts that LOWER to PQL push into
+        # that table's scan (below the join); everything else — multi-
+        # table or unlowerable — stays a host residual above the join.
+        # Under a LEFT join only the base table's pushdown is semantics-
+        # preserving (a right-side WHERE must see the null-padded rows).
+        # The split runs to completion BEFORE needed-column collection so
+        # residual conjuncts' columns are always projected by the scans.
+        any_left = any(j.kind == "LEFT" for j in s.joins)
+        lowered: Dict[str, List[Call]] = {a: [] for a in aliases}
+        residual: List[ast.Expr] = []
+        for c in _flatten_and(where) if where is not None else []:
+            owners = {r.table for r in _qualified_refs(c)}
+            if len(owners) == 1:
+                a = owners.pop()
+                if a == aliases[0] or not any_left:
+                    try:
+                        lowered[a].append(
+                            self.lower_filter(idxs[a], _unqualify(c)))
+                        continue
+                    except CannotLower:
+                        pass
+            residual.append(c)
+
+        # needed columns per table (incl. host-residual references)
+        need: Dict[str, set] = {a: set() for a in aliases}
+        for e in ([it.expr for it in items] + ons + group_by +
+                  ([having] if having is not None else []) +
+                  [t.expr for t in order_by] + residual):
+            for r in _qualified_refs(e):
+                need[r.table].add(r.name)
+
+        # per-table scans: PQL pushdown filter + alias-qualified schema
+        scans: Dict[str, PlanOp] = {}
+        for a in aliases:
+            calls = lowered[a]
+            filter_call = (calls[0] if len(calls) == 1
+                           else Call("Intersect", children=calls)
+                           if calls else None)
+            scan: PlanOp = self._scan_op(
+                idxs[a], sorted(need[a] - {"_id"}), filter_call)
+            scans[a] = plan.AliasOp(scan, a)
+
+        # left-deep join chain
+        op: PlanOp = scans[aliases[0]]
+        seen = {aliases[0]}
+        for j, on in zip(s.joins, ons):
+            a = j.alias or j.table
+            equi, extra = [], []
+            for c in _flatten_and(on):
+                pair = _equi_pair(c, seen, a)
+                if pair is not None:
+                    equi.append(pair)
+                else:
+                    extra.append(c)
+            if not equi:
+                raise SQLError(
+                    "JOIN requires at least one equi condition in ON")
+            res = None
+            for c in extra:
+                res = c if res is None else ast.Binary("AND", res, c)
+            op = plan.JoinOp(op, scans[a], equi, _to_keys(res),
+                             kind=j.kind)
+            seen.add(a)
+        for c in residual:
+            op = plan.FilterOp(op, _to_keys(c))
+
+        def jtype(e: ast.Expr) -> str:
+            if isinstance(e, ast.ColumnRef) and e.table in idxs:
+                return self._item_type(idxs[e.table],
+                                       ast.ColumnRef(e.name))
+            if isinstance(e, ast.FuncCall):
+                if e.name == "COUNT":
+                    return "INT"
+                if e.name in ("SUM", "MIN", "MAX", "PERCENTILE") and \
+                        e.args and isinstance(e.args[0], ast.ColumnRef):
+                    return jtype(e.args[0])
+                if e.name == "AVG":
+                    return "DECIMAL(4)"
+                return "INT"
+            return self._item_type(idxs[aliases[0]], _unqualify(e))
+
+        ctx = _QueryCtx()
+        aggs = _collect_aggs(items, having, order_by)
+        if group_by or aggs:
+            op = self._join_aggregate(op, items, group_by, having, aggs,
+                                      jtype, ctx, bool(order_by))
+        else:
+            proj = [(self._item_name(it, i), jtype(it.expr),
+                     _to_keys(it.expr))
+                    for i, it in enumerate(items)]
+            names = {p[0] for p in proj}
+            for t in order_by:
+                for r in _qualified_refs(t.expr):
+                    key = f"{r.table}.{r.name}"
+                    if r.name not in names and key not in names:
+                        ctx.hidden.append((key, jtype(r), _to_keys(r)))
+                        names.add(key)
+            op = plan.ProjectOp(op, proj + ctx.hidden)
+        if order_by:
+            by_item = {repr(it.expr): self._item_name(it, i)
+                       for i, it in enumerate(items)}
+            terms = []
+            for t in order_by:
+                if repr(t.expr) in by_item:
+                    terms.append((ast.ColumnRef(by_item[repr(t.expr)]),
+                                  t.desc))
+                else:
+                    terms.append((_to_keys(_rewrite_ctx(t.expr, ctx)),
+                                  t.desc))
+            op = plan.OrderByOp(op, terms)
+            if ctx.hidden:
+                op = _TrimOp(op, len(op.schema) - len(ctx.hidden))
+        if s.distinct:
+            op = plan.DistinctOp(op)
+        limit = s.limit if s.limit is not None else s.top
+        if limit is not None or s.offset:
+            op = plan.LimitOp(op, limit, s.offset)
+        return op
+
+    def _join_aggregate(self, op: PlanOp, items, group_by, having, aggs,
+                        jtype, ctx: _QueryCtx, with_hidden: bool) -> PlanOp:
+        """Host grouping over the joined stream (reference:
+        opgroupby.go above the join). ``with_hidden`` rides every
+        aggregate along as a hidden column for ORDER BY resolution
+        (trimmed after the sort)."""
+        group_names: List[str] = []
+        computed: List[tuple] = []
+        for i, g in enumerate(group_by):
+            if isinstance(g, ast.ColumnRef):
+                group_names.append(f"{g.table}.{g.name}" if g.table
+                                   else g.name)
+            else:
+                name = f"__grp{i}"
+                ctx.grp_rewrites[repr(g)] = name
+                computed.append((name, jtype(g), _to_keys(g)))
+                group_names.append(name)
+        if computed:
+            passthrough = [(n, t, ast.ColumnRef(n)) for n, t in op.schema]
+            op = plan.ProjectOp(op, passthrough + computed)
+        agg_names = self._name_aggs(aggs, ctx)
+        hidden = []
+        if with_hidden:
+            for a in aggs:
+                hidden.append((ctx.agg_names[_agg_key(a)], jtype(a),
+                               ast.ColumnRef(ctx.agg_names[_agg_key(a)])))
+        ctx.hidden = hidden
+        specs = []
+        for a in aggs:
+            expr = None if (a.args and isinstance(a.args[0], ast.Star)) \
+                else (_to_keys(a.args[0]) if a.args else None)
+            specs.append((agg_names[_agg_key(a)], "INT",
+                          AggSpec(a.name, expr, distinct=a.distinct)))
+        op = plan.GroupByOp(op, group_names, specs)
+        if having is not None:
+            op = plan.FilterOp(op, _to_keys(_rewrite_ctx(having, ctx)))
+        proj = [(self._item_name(it, i), jtype(it.expr),
+                 _to_keys(_rewrite_ctx(it.expr, ctx)))
+                for i, it in enumerate(items)] + ctx.hidden
+        return plan.ProjectOp(op, proj)
+
     def _plan_host_aggregate(self, idx: Index, s: ast.SelectStatement,
                              items: List[ast.SelectItem],
                              aggs: List[ast.FuncCall],
@@ -665,6 +892,93 @@ class _TrimOp(PlanOp):
 
 
 # -- helpers -----------------------------------------------------------------
+
+def _strip_single_table_quals(s: ast.SelectStatement) -> ast.SelectStatement:
+    """`SELECT o.price FROM orders o` — validate each qualifier names the
+    one table (by alias or table name) and strip it so the single-table
+    pipeline's unqualified env keys resolve."""
+    allowed = {s.table, s.table_alias} - {None}
+
+    def strip(e):
+        for r in _qualified_refs(e):
+            if r.table is not None and r.table not in allowed:
+                raise SQLError(f"unknown table alias {r.table!r}")
+        return _unqualify(e)
+
+    return dataclasses.replace(
+        s,
+        items=[ast.SelectItem(strip(it.expr)
+                              if not isinstance(it.expr, ast.Star)
+                              else it.expr, it.alias) for it in s.items],
+        where=strip(s.where) if s.where is not None else None,
+        group_by=[strip(g) for g in s.group_by],
+        having=strip(s.having) if s.having is not None else None,
+        order_by=[ast.OrderTerm(strip(t.expr), t.desc) for t in s.order_by],
+    )
+
+
+def _map_refs(e: ast.Expr, fn) -> ast.Expr:
+    """Rebuild an expression with ``fn`` applied to every ColumnRef —
+    the single traversal behind qualification/stripping/collection (any
+    new Expr node type needs exactly one case added here)."""
+    if isinstance(e, ast.ColumnRef):
+        return fn(e)
+    if isinstance(e, ast.Binary):
+        return ast.Binary(e.op, _map_refs(e.left, fn), _map_refs(e.right, fn))
+    if isinstance(e, ast.Unary):
+        return ast.Unary(e.op, _map_refs(e.operand, fn))
+    if isinstance(e, ast.InList):
+        return ast.InList(_map_refs(e.operand, fn),
+                          [_map_refs(i, fn) for i in e.items], e.negated)
+    if isinstance(e, ast.Between):
+        return ast.Between(_map_refs(e.operand, fn), _map_refs(e.low, fn),
+                           _map_refs(e.high, fn), e.negated)
+    if isinstance(e, ast.IsNull):
+        return ast.IsNull(_map_refs(e.operand, fn), e.negated)
+    if isinstance(e, ast.Like):
+        return ast.Like(_map_refs(e.operand, fn), e.pattern, e.negated)
+    if isinstance(e, ast.FuncCall):
+        return ast.FuncCall(e.name, [_map_refs(a, fn) for a in e.args],
+                            distinct=e.distinct)
+    return e
+
+
+def _qualified_refs(e: Optional[ast.Expr]) -> List[ast.ColumnRef]:
+    """All ColumnRef nodes of a (post-qualify) expression."""
+    out: List[ast.ColumnRef] = []
+    if e is not None:
+        _map_refs(e, lambda r: (out.append(r), r)[1])
+    return out
+
+
+def _unqualify(e: ast.Expr) -> ast.Expr:
+    """Strip table qualifiers (for lowering a single-table conjunct
+    against that table's index)."""
+    return _map_refs(e, lambda r: ast.ColumnRef(r.name))
+
+
+def _equi_pair(c: ast.Expr, seen_aliases: set, right_alias: str):
+    """(left key, right key) when c is `a.x = b.y` joining the
+    accumulated left side to the table being joined; else None."""
+    if not (isinstance(c, ast.Binary) and c.op == "="):
+        return None
+    l, r = c.left, c.right
+    if not (isinstance(l, ast.ColumnRef) and isinstance(r, ast.ColumnRef)):
+        return None
+    if l.table == right_alias and r.table in seen_aliases:
+        l, r = r, l
+    if l.table in seen_aliases and r.table == right_alias:
+        return (f"{l.table}.{l.name}", f"{r.table}.{r.name}")
+    return None
+
+
+def _to_keys(e):
+    """Expressions over joined streams evaluate as-is: plan.eval_expr
+    resolves qualified refs against the 'alias.col' env keys AliasOp
+    establishes. Kept as the single seam where a different key scheme
+    would plug in."""
+    return e
+
 
 def _flatten_and(e: ast.Expr) -> List[ast.Expr]:
     if isinstance(e, ast.Binary) and e.op == "AND":

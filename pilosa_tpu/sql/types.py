@@ -58,6 +58,28 @@ def column_to_field_options(cd: ast.ColumnDef) -> FieldOptions:
     raise SQLError(f"unsupported SQL type {t!r}")
 
 
+def column_to_options_dict(cd: ast.ColumnDef) -> dict:
+    """ColumnDef -> the JSON options dict the api/cluster create_field
+    surface takes (so SQL DDL broadcasts like any schema change)."""
+    fo = column_to_field_options(cd)
+    d = {"type": fo.type.value, "keys": fo.keys}
+    if fo.min is not None:
+        d["min"] = fo.min
+    if fo.max is not None:
+        d["max"] = fo.max
+    if fo.scale:
+        d["scale"] = fo.scale
+    if fo.time_unit != "s":
+        d["timeUnit"] = fo.time_unit
+    if fo.time_quantum:
+        d["timeQuantum"] = fo.time_quantum
+    if fo.ttl_seconds:
+        d["ttl"] = fo.ttl_seconds
+    d["cacheType"] = fo.cache_type
+    d["cacheSize"] = fo.cache_size
+    return d
+
+
 def field_to_sql_type(opts: FieldOptions) -> str:
     ft = opts.type
     if ft == FieldType.MUTEX:

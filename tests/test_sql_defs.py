@@ -1,0 +1,352 @@
+"""SQL conformance suite — table-driven port of the reference's defs
+(reference: sql3/test/defs/defs*.go; SURVEY §4.6 calls these executable
+specs and says to port the tables). Areas covered: unkeyed/keyed selects,
+filter predicates, BETWEEN/IN/LIKE/IS NULL, binops/unops, bool fields,
+aggregates, GROUP BY/HAVING, ORDER BY/TOP/LIMIT/OFFSET, DISTINCT, NULL
+three-valued logic, JOINs (defs_join.go), DELETE, REPLACE, and a
+multi-shard table. Every read-only case runs against BOTH a single-node
+API and a non-coordinator node of a 3-node HTTP cluster (the reference
+runs defs against an in-process cluster, sql3/sql_test.go) —
+the VERDICT r3 #3 done-criterion.
+"""
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster import LocalCluster
+
+SETUP = [
+    # defs_unkeyed.go model
+    "create table unkeyed (_id id, an_int int min 0 max 100, "
+    "an_id_set idset, an_id id, a_string string, a_string_set stringset, "
+    "a_dec decimal(2))",
+    "insert into unkeyed values "
+    "(1, 11, [11,12,13], 101, 'str1', ['a1','b1','c1'], 123.45),"
+    "(2, 22, [21,22,23], 201, 'str2', ['a2','b2','c2'], 234.56),"
+    "(3, 33, [31,32,33], 301, 'str3', ['a3','b3','c3'], 345.67),"
+    "(4, 44, [41,42,43], 401, 'str4', ['a4','b4','c4'], 456.78)",
+    # defs_keyed.go model
+    "create table keyed (_id string, v int, tag stringset)",
+    "insert into keyed values ('one', 1, ['red']), "
+    "('two', 2, ['red','blue']), ('three', 3, ['blue'])",
+    # defs_bool.go model
+    "create table bools (_id id, b bool)",
+    "insert into bools values (1, true), (2, false), (3, true)",
+    # defs_groupby.go / defs_aggregate.go model
+    "create table agg (_id id, seg id, n int, d decimal(2))",
+    "insert into agg values (1, 10, 5, 1.50), (2, 10, 7, 2.25), "
+    "(3, 20, 1, 0.75), (4, 20, 3, 1.00), (5, 30, 9, 4.10)",
+    # defs_null.go model
+    "create table nulls (_id id, a int, s string)",
+    "insert into nulls (_id, a, s) values (1, 10, 'x'), (2, null, 'y'), "
+    "(3, 20, null)",
+    # defs_join.go tables (same data as the reference)
+    "create table users (_id id, name string, age int)",
+    "insert into users values (0,'a',21),(1,'b',18),(2,'c',28),"
+    "(3,'d',34),(4,'e',36)",
+    "create table orders (_id id, userid int, price decimal(2))",
+    "insert into orders values (0,1,9.99),(1,0,3.99),(2,2,14.99),"
+    "(3,3,5.99),(4,1,12.99),(5,2,1.99)",
+    # multi-shard table (cluster distribution)
+    "create table big (_id id, seg id, n int)",
+    "insert into big values (5, 1, 2), (1048581, 1, 3), "
+    "(2097157, 2, 4), (10, 2, 1)",
+]
+
+# (name, sql, expected rows, ordered)
+CASES = [
+    # -- selects & filter predicates (defs_unkeyed/defs_filterpredicates) --
+    ("select-cols", "select _id, an_int from unkeyed",
+     [[1, 11], [2, 22], [3, 33], [4, 44]], False),
+    ("top", "select top(2) _id from unkeyed", [[1], [2]], False),
+    ("where-int-eq", "select _id from unkeyed where an_int = 22",
+     [[2]], False),
+    ("where-string-eq", "select _id from unkeyed where a_string = 'str2'",
+     [[2]], False),
+    ("where-id-eq", "select _id from unkeyed where an_id = 201",
+     [[2]], False),
+    ("where-idset", "select _id from unkeyed where setcontains(an_id_set, 21)",
+     [[2]], False),
+    ("where-stringset",
+     "select _id from unkeyed where setcontains(a_string_set, 'a2')",
+     [[2]], False),
+    ("where-ne", "select _id from unkeyed where an_int != 22",
+     [[1], [3], [4]], False),
+    ("where-lt", "select _id from unkeyed where an_int < 33",
+     [[1], [2]], False),
+    ("where-le", "select _id from unkeyed where an_int <= 33",
+     [[1], [2], [3]], False),
+    ("where-gt", "select _id from unkeyed where an_int > 22",
+     [[3], [4]], False),
+    ("where-ge", "select _id from unkeyed where an_int >= 22",
+     [[2], [3], [4]], False),
+    ("where-and",
+     "select _id from unkeyed where an_int > 11 and an_int < 44",
+     [[2], [3]], False),
+    ("where-or",
+     "select _id from unkeyed where an_int = 11 or an_int = 44",
+     [[1], [4]], False),
+    ("where-not", "select _id from unkeyed where not an_int = 22",
+     [[1], [3], [4]], False),
+    ("where-id-filter", "select an_int from unkeyed where _id = 3",
+     [[33]], False),
+    ("where-id-in", "select _id from unkeyed where _id in (1, 4)",
+     [[1], [4]], False),
+    # -- BETWEEN / IN (defs_between.go, defs_in.go) ------------------------
+    ("between", "select _id from unkeyed where an_int between 22 and 33",
+     [[2], [3]], False),
+    ("not-between",
+     "select _id from unkeyed where an_int not between 22 and 33",
+     [[1], [4]], False),
+    ("in", "select _id from unkeyed where an_int in (11, 33)",
+     [[1], [3]], False),
+    ("not-in", "select _id from unkeyed where an_int not in (11, 33)",
+     [[2], [4]], False),
+    # -- LIKE (defs_like.go) -----------------------------------------------
+    ("like-prefix", "select _id from unkeyed where a_string like 'str%'",
+     [[1], [2], [3], [4]], False),
+    ("like-suffix", "select _id from unkeyed where a_string like '%2'",
+     [[2]], False),
+    ("not-like", "select _id from unkeyed where a_string not like '%2'",
+     [[1], [3], [4]], False),
+    # -- binops / unops (defs_binops.go, defs_unops.go) --------------------
+    ("proj-arith", "select _id, an_int + 1 from unkeyed where _id = 1",
+     [[1, 12]], False),
+    ("proj-mul", "select an_int * 2 from unkeyed where _id = 2",
+     [[44]], False),
+    ("binop-const", "select 2 + 3 * 4", [[14]], False),
+    ("binop-intdiv", "select 7 / 2", [[3]], False),
+    ("binop-mod", "select 10 % 3", [[1]], False),
+    ("unop-neg", "select -5", [[-5]], False),
+    # -- bool fields (defs_bool.go) ----------------------------------------
+    ("bool-true", "select _id from bools where b = true",
+     [[1], [3]], False),
+    ("bool-false", "select _id from bools where b = false", [[2]], False),
+    ("bool-bare", "select _id from bools where b", [[1], [3]], False),
+    ("bool-not", "select _id from bools where not b", [[2]], False),
+    # -- aggregates (defs_aggregate.go) ------------------------------------
+    ("count-star", "select count(*) from unkeyed", [[4]], False),
+    ("count-col", "select count(an_int) from unkeyed", [[4]], False),
+    ("sum", "select sum(an_int) from unkeyed", [[110]], False),
+    ("avg", "select avg(an_int) from unkeyed", [[27.5]], False),
+    ("min", "select min(an_int) from unkeyed", [[11]], False),
+    ("max", "select max(an_int) from unkeyed", [[44]], False),
+    ("sum-filtered", "select sum(an_int) from unkeyed where an_int > 20",
+     [[99]], False),
+    ("count-filtered", "select count(*) from unkeyed where an_int >= 22",
+     [[3]], False),
+    ("count-distinct", "select count(distinct seg) from agg", [[3]], False),
+    ("count-distinct-n", "select count(distinct n) from agg", [[5]], False),
+    ("sum-distinct", "select sum(distinct n) from agg", [[25]], False),
+    # -- GROUP BY / HAVING (defs_groupby.go, defs_having.go) ---------------
+    ("groupby-count", "select seg, count(*) from agg group by seg",
+     [[10, 2], [20, 2], [30, 1]], False),
+    ("groupby-sum", "select seg, sum(n) from agg group by seg",
+     [[10, 12], [20, 4], [30, 9]], False),
+    ("groupby-where",
+     "select seg, count(*) from agg where n > 2 group by seg",
+     [[10, 2], [20, 1], [30, 1]], False),
+    ("groupby-having",
+     "select seg, count(*) from agg group by seg having count(*) > 1",
+     [[10, 2], [20, 2]], False),
+    ("groupby-min", "select seg, min(n) from agg group by seg",
+     [[10, 5], [20, 1], [30, 9]], False),
+    ("groupby-max", "select seg, max(n) from agg group by seg",
+     [[10, 7], [20, 3], [30, 9]], False),
+    ("groupby-avg", "select seg, avg(n) from agg group by seg",
+     [[10, 6.0], [20, 2.0], [30, 9.0]], False),
+    ("groupby-order-agg",
+     "select seg, sum(n) from agg group by seg order by sum(n) desc",
+     [[10, 12], [30, 9], [20, 4]], True),
+    # -- ORDER BY / LIMIT / OFFSET (defs_orderby.go, defs_top.go) ----------
+    ("orderby-desc", "select _id from unkeyed order by an_int desc",
+     [[4], [3], [2], [1]], True),
+    ("orderby-asc", "select _id, an_int from unkeyed order by an_int",
+     [[1, 11], [2, 22], [3, 33], [4, 44]], True),
+    ("limit-offset",
+     "select _id from unkeyed order by _id limit 2 offset 1",
+     [[2], [3]], True),
+    # -- DISTINCT (defs_distinct.go) ---------------------------------------
+    ("distinct-seg", "select distinct seg from agg",
+     [[10], [20], [30]], False),
+    # -- NULL three-valued logic (defs_null.go) ----------------------------
+    ("null-is", "select _id from nulls where a is null", [[2]], False),
+    ("null-isnot", "select _id from nulls where a is not null",
+     [[1], [3]], False),
+    ("null-s-is", "select _id from nulls where s is null", [[3]], False),
+    ("null-count", "select count(a) from nulls", [[2]], False),
+    ("null-sum", "select sum(a) from nulls", [[30]], False),
+    ("null-cmp-excludes", "select _id from nulls where a > 5",
+     [[1], [3]], False),
+    ("null-ne-excludes", "select _id from nulls where a != 10",
+     [[3]], False),
+    ("null-proj", "select a + 1 from nulls where _id = 2", [[None]], False),
+    # -- keyed tables (defs_keyed.go) --------------------------------------
+    ("keyed-select", "select _id, v from keyed order by v",
+     [["one", 1], ["two", 2], ["three", 3]], True),
+    ("keyed-where-id", "select v from keyed where _id = 'two'",
+     [[2]], False),
+    ("keyed-set", "select _id from keyed where setcontains(tag, 'red')",
+     [["one"], ["two"]], False),
+    ("keyed-sum", "select sum(v) from keyed", [[6]], False),
+    # -- JOINs (defs_join.go — same data and expected values) --------------
+    ("join-groupby",
+     "select u._id, sum(orders.price) from orders o inner join users u "
+     "on o.userid = u._id group by u._id",
+     [[0, 3.99], [1, 22.98], [2, 16.98], [3, 5.99]], False),
+    ("join-sum-filter",
+     "select sum(price) from orders o inner join users u "
+     "on o.userid = u._id where u.age > 20",
+     [[26.96]], False),
+    ("join-sum-double-filter",
+     "select sum(price) from orders o inner join users u "
+     "on o.userid = u._id where u.age > 20 and o.price < 10.00",
+     [[11.97]], False),
+    ("join-count-distinct",
+     "SELECT COUNT(DISTINCT u.name) FROM orders o JOIN users u "
+     "ON o.userid = u._id WHERE o.price > 9",
+     [[2]], False),
+    ("join-left",
+     "select u.name, o.price from users u left join orders o "
+     "on o.userid = u._id order by u.name, o.price",
+     [["a", 3.99], ["b", 9.99], ["b", 12.99], ["c", 1.99], ["c", 14.99],
+      ["d", 5.99], ["e", None]], True),
+    ("join-count", "select count(*) from orders o join users u "
+     "on o.userid = u._id", [[6]], False),
+    # -- multi-shard (cluster distribution) --------------------------------
+    ("big-count", "select count(*) from big", [[4]], False),
+    ("big-sum", "select sum(n) from big", [[10]], False),
+    ("big-groupby", "select seg, sum(n) from big group by seg",
+     [[1, 5], [2, 5]], False),
+    ("big-where", "select _id from big where n >= 3",
+     [[1048581], [2097157]], False),
+]
+
+
+def _norm(v):
+    if isinstance(v, list):
+        return tuple(sorted(map(str, v)))
+    if isinstance(v, float):
+        return round(v, 6)
+    return v
+
+
+def _rows(res):
+    return [[_norm(v) for v in row] for row in res.data]
+
+
+def _check(backend, sql, expected, ordered):
+    got = _rows(backend.sql(sql))
+    want = [[_norm(v) for v in row] for row in expected]
+    if not ordered:
+        got = sorted(got, key=repr)
+        want = sorted(want, key=repr)
+    assert got == want, f"{sql}\n got: {got}\nwant: {want}"
+
+
+@pytest.fixture(scope="module")
+def single():
+    api = API()
+    for stmt in SETUP:
+        api.sql(stmt)
+    return api
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(3)
+    for stmt in SETUP:
+        c.coordinator.sql(stmt)
+    yield c
+    c.close()
+
+
+@pytest.mark.parametrize("name,sql,expected,ordered",
+                         CASES, ids=[c[0] for c in CASES])
+def test_defs_single_node(single, name, sql, expected, ordered):
+    _check(single, sql, expected, ordered)
+
+
+@pytest.mark.parametrize("name,sql,expected,ordered",
+                         CASES, ids=[c[0] for c in CASES])
+def test_defs_cluster_3node(cluster, name, sql, expected, ordered):
+    # a NON-coordinator node serves every case: schema arrived by
+    # broadcast, data by shard routing (reference: sql3 defs run against
+    # test.MustRunCluster)
+    _check(cluster[1], sql, expected, ordered)
+
+
+def test_star_schema(single):
+    res = single.sql("select * from unkeyed")
+    assert sorted(n for n, _ in res.schema) == [
+        "_id", "a_dec", "a_string", "a_string_set", "an_id", "an_id_set",
+        "an_int"]
+    assert len(res.data) == 4
+
+
+class TestDefsDML:
+    """DELETE / REPLACE semantics (defs_delete.go, defs_keyed_insert.go)
+    — mutating, so each test builds its own table."""
+
+    def test_delete_where(self):
+        api = API()
+        api.sql("create table del1 (_id id, v int)")
+        api.sql("insert into del1 values (1,1),(2,2),(3,3),(4,4)")
+        api.sql("delete from del1 where v > 2")
+        assert api.sql("select count(*) from del1").data == [[2]]
+        api.sql("delete from del1")
+        assert api.sql("select count(*) from del1").data == [[0]]
+
+    def test_replace_resets_sets(self):
+        api = API()
+        api.sql("create table ups (_id id, tag idset)")
+        api.sql("insert into ups values (1, [1, 2])")
+        api.sql("replace into ups values (1, [3])")
+        assert _rows(api.sql("select tag from ups")) == [[("3",)]]
+
+    def test_insert_merges_sets(self):
+        api = API()
+        api.sql("create table ups2 (_id id, tag idset)")
+        api.sql("insert into ups2 values (1, [1, 2])")
+        api.sql("insert into ups2 values (1, [3])")
+        assert _rows(api.sql("select tag from ups2")) == [[("1", "2", "3")]]
+
+    def test_cluster_delete(self):
+        c = LocalCluster(3)
+        try:
+            co = c.coordinator
+            co.sql("create table cdel (_id id, v int)")
+            co.sql("insert into cdel values (5,1),(1048581,2),(2097157,3)")
+            assert c[1].sql("select count(*) from cdel").data == [[3]]
+            co.sql("delete from cdel where v >= 2")
+            assert c[2].sql("select count(*) from cdel").data == [[1]]
+        finally:
+            c.close()
+
+
+class TestReviewRegressions:
+    """Fixes from the round-4 review: residual JOIN conjuncts must have
+    their columns projected; single-table queries accept their own
+    qualifier."""
+
+    def test_join_unlowerable_where_conjunct(self):
+        api = API()
+        api.sql("create table o2 (_id id, userid int, price int)")
+        api.sql("create table u2 (_id id, age int)")
+        api.sql("insert into o2 values (0, 1, 16), (1, 2, 5)")
+        api.sql("insert into u2 values (1, 30), (2, 40)")
+        # `price + 0 > 15` has no PQL form -> host residual above the
+        # join; its column must still be scanned
+        r = api.sql("select o2._id from o2 inner join u2 "
+                    "on o2.userid = u2._id where o2.price + 0 > 15")
+        assert r.data == [[0]], r.data
+
+    def test_single_table_alias_qualifier(self):
+        api = API()
+        api.sql("create table sq (_id id, price int)")
+        api.sql("insert into sq values (1, 5), (2, 9)")
+        assert api.sql("select o.price from sq o where o.price > 6"
+                       ).data == [[9]]
+        assert api.sql("select sq.price from sq").data == [[5], [9]]
+        with pytest.raises(Exception):
+            api.sql("select zz.price from sq o")
