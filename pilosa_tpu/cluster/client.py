@@ -87,6 +87,11 @@ class InternalClient:
 
     # -- imports (reference: internal_client.go:691-931) -------------------
 
+    def send_directive(self, node, payload: dict) -> dict:
+        """DAX controller -> computer assignment push (reference:
+        dax/controller/controller.go:1033 sendDirectives -> /directive)."""
+        return self._post(node, "/directive", payload)
+
     def import_bits(self, node, index: str, field: str, payload: dict) -> dict:
         return self._post(node, f"/index/{index}/import", payload)
 

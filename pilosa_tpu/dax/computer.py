@@ -1,0 +1,291 @@
+"""Computer: a stateless DAX compute node.
+
+Reference: the featurebase server in compute mode — check-in loop
+(server/server.go:298), directive application (api_directive.go:21-144),
+shard state rebuilt from Snapshotter + Writelogger (dax/storage/,
+cluster.go daxstorage hooks). Every write is appended to the shared-FS
+writelog BEFORE it applies locally (the durability contract that makes
+the node stateless: kill it and the next owner replays), and logs
+compact into snapshots past an op threshold.
+
+Serves the same /internal/* HTTP surface as a classic cluster node, so
+the Queryer talks to it through the unchanged InternalClient.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.core.fragment import _grow_rows
+from pilosa_tpu.core.stacked import release_field_cache
+from pilosa_tpu.dax.directive import (
+    Directive, METHOD_FULL, METHOD_RESET,
+)
+from pilosa_tpu.dax.storage import Snapshotter, WriteLogger
+from pilosa_tpu.pql.executor import Executor, has_write_calls
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql.result import result_to_wire
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class Computer:
+    def __init__(self, node_id: str, shared_dir: str, uri: str = "",
+                 snapshot_every: int = 256):
+        self.api = API()
+        self.node = Node(id=node_id, uri=uri)
+        self.wl = WriteLogger(shared_dir)
+        self.snap = Snapshotter(shared_dir)
+        self.snapshot_every = snapshot_every
+        self.directive_version = -1
+        self.assigned: Set[Tuple[str, int]] = set()
+        self._exec = Executor(self.api.holder, remote=True)
+
+    # -- directive application (reference: api_directive.go:21) ------------
+
+    def apply_directive(self, d_json: dict) -> dict:
+        d = Directive.from_json(d_json)
+        if d.method != METHOD_RESET and d.version <= self.directive_version:
+            # stale or duplicate push: reject regressions (:26-41)
+            return {"version": self.directive_version, "applied": False}
+        if d.method == METHOD_RESET:
+            # wipe and reload from shared storage (:63 DirectiveMethodReset)
+            self.api = API()
+            self._exec = Executor(self.api.holder, remote=True)
+            self.assigned = set()
+        self._apply_schema(d.schema)
+        want = set(d.assigned)
+        for table, shard in sorted(self.assigned - want):
+            self._drop_shard(table, shard)
+        for table, shard in sorted(want - self.assigned):
+            self._load_shard(table, shard)
+        self.assigned = want
+        self.directive_version = d.version
+        return {"version": d.version, "applied": True}
+
+    def _apply_schema(self, schema: List[dict]) -> None:
+        holder = self.api.holder
+        keep = set()
+        for t in schema:
+            keep.add(t["index"])
+            if t["index"] not in holder.indexes:
+                self.api.create_index(t["index"], t.get("options"))
+            idx = holder.index(t["index"])
+            for f in t.get("fields", []):
+                if f["name"] not in idx.fields:
+                    self.api.create_field(t["index"], f["name"],
+                                          f.get("options"))
+        for name in list(holder.indexes):
+            if name not in keep:
+                self.api.delete_index(name)
+
+    def _drop_shard(self, table: str, shard: int) -> None:
+        idx = self.api.holder.indexes.get(table)
+        if idx is None:
+            return
+        for field in idx.fields.values():
+            for frags in field.views.values():
+                frags.pop(shard, None)
+            field.bsi.pop(shard, None)
+            release_field_cache(field)
+
+    # -- shard resume: snapshot + log replay (reference: dax/storage/) -----
+
+    def _load_shard(self, table: str, shard: int) -> None:
+        from_version = 0
+        latest = self.snap.latest(table, shard)
+        if latest is not None:
+            from_version, arrays = latest
+            self._install_snapshot(table, shard, arrays)
+        for op in self.wl.replay(table, shard, from_version):
+            # Replay is total: an op that fails application (it failed
+            # identically for its original client) must not wedge the
+            # shard on every future owner — skip it loudly.
+            try:
+                self._apply_op(table, op, shard)
+            except Exception as exc:
+                import logging
+
+                logging.getLogger("pilosa_tpu.dax").warning(
+                    "writelog replay skipped bad op on %s/%d: %r",
+                    table, shard, exc)
+
+    def _export_shard(self, table: str, shard: int) -> Dict[str, np.ndarray]:
+        """The shard's planes as named arrays (the snapshot payload)."""
+        idx = self.api.holder.index(table)
+        out: Dict[str, np.ndarray] = {}
+        for fname, field in idx.fields.items():
+            for view, frags in field.views.items():
+                frag = frags.get(shard)
+                if frag is not None and frag.row_ids:
+                    n = len(frag.row_ids)
+                    out[f"set|{fname}|{view}"] = frag.planes[:n]
+                    out[f"rows|{fname}|{view}"] = np.asarray(
+                        frag.row_ids, dtype=np.int64)
+            bfrag = field.bsi.get(shard)
+            if bfrag is not None:
+                out[f"bsi|{fname}"] = bfrag.planes
+        return out
+
+    def _install_snapshot(self, table: str, shard: int,
+                          arrays: Dict[str, np.ndarray]) -> None:
+        idx = self.api.holder.index(table)
+        for key, arr in arrays.items():
+            parts = key.split("|")
+            if parts[0] == "set":
+                _, fname, view = parts
+                frag = idx.field(fname).fragment(shard, view, create=True)
+                rows = arrays[f"rows|{fname}|{view}"]
+                frag.row_ids = [int(r) for r in rows]
+                frag.row_index = {int(r): i for i, r in enumerate(rows)}
+                frag.planes = _grow_rows(
+                    np.ascontiguousarray(arr, dtype=np.uint32), len(rows))
+                frag.version += 1
+                frag.deltas.reset(frag.version)
+            elif parts[0] == "bsi":
+                _, fname = parts
+                bfrag = idx.field(fname).bsi_fragment(shard, create=True)
+                bfrag.planes = np.ascontiguousarray(arr, dtype=np.uint32)
+                bfrag.depth = bfrag.planes.shape[0] - 2
+                bfrag.version += 1
+                bfrag.deltas.reset(bfrag.version)
+
+    def _apply_op(self, table: str, op: dict, shard: int) -> None:
+        k = op["k"]
+        if k == "pql":
+            # restricted to the log's own shard: multi-shard write calls
+            # (Delete/ClearRow/Store) are logged into EVERY owned shard's
+            # log, and replay order across shards must not matter
+            self._exec.execute(table, parse(op["q"]), shards=[shard])
+        elif k == "bits":
+            self.api.import_bits(table, op["f"], rows=op["r"], cols=op["c"],
+                                 clear=bool(op.get("x")))
+        elif k == "vals":
+            self.api.import_values(table, op["f"], cols=op["c"],
+                                   values=op["v"])
+        elif k == "roaring":
+            views = {v: base64.b64decode(b) for v, b in op["views"].items()}
+            self.api.import_roaring(table, op["f"], op["s"], views,
+                                    clear=bool(op.get("x")))
+        else:
+            raise ValueError(f"unknown writelog op kind {k!r}")
+
+    def maybe_snapshot(self, table: str, shard: int) -> None:
+        n = self.wl.length(table, shard)
+        if n and n % self.snapshot_every == 0:
+            self.snap.write(table, shard, n, self._export_shard(table, shard))
+
+    # -- internal serving surface (same shape as ClusterNode) --------------
+
+    def query_remote(self, index: str, pql: str,
+                     shards: Sequence[int]) -> List[dict]:
+        q = parse(pql)
+        touched: Set[int] = set()
+        if has_write_calls(q):
+            for call in q.calls:
+                inner = call
+                while inner.name == "Options":
+                    inner = inner.children[0]
+                if inner.name in ("Set", "Clear"):
+                    ws = [int(inner.arg("_col")) // SHARD_WIDTH]
+                else:  # Store / ClearRow / Delete: every local shard
+                    ws = sorted(shards) or sorted(
+                        self.api.holder.index(index).shards())
+                for s in ws:
+                    self.wl.append(index, s, {"k": "pql",
+                                              "q": inner.to_pql()})
+                    touched.add(s)
+        results = self._exec.execute(index, q, shards=shards)
+        for s in touched:
+            self.maybe_snapshot(index, s)
+        return [result_to_wire(r) for r in results]
+
+    def import_bits(self, index: str, field: str, rows=None, cols=None,
+                    row_keys=None, col_keys=None, clear: bool = False,
+                    remote: bool = False) -> int:
+        if row_keys or col_keys:
+            # globally-consistent key translation needs the translate
+            # service role (reference: dax translate workers) — refusing
+            # beats silently writing nothing
+            raise NotImplementedError(
+                "DAX compute nodes take pre-translated IDs; keyed imports "
+                "need the translate service")
+        by_shard: Dict[int, Tuple[list, list]] = {}
+        for r, c in zip(rows or [], cols or []):
+            ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
+            ent[0].append(int(r))
+            ent[1].append(int(c))
+        total = 0
+        for shard, (rs, cs) in sorted(by_shard.items()):
+            self.wl.append(index, shard,
+                           {"k": "bits", "f": field, "r": rs, "c": cs,
+                            "x": int(clear)})
+            total += self.api.import_bits(index, field, rows=rs, cols=cs,
+                                          clear=clear)
+            self.maybe_snapshot(index, shard)
+        return total
+
+    def import_values(self, index: str, field: str, cols=None, values=None,
+                      col_keys=None, remote: bool = False) -> int:
+        if col_keys:
+            raise NotImplementedError(
+                "DAX compute nodes take pre-translated IDs; keyed imports "
+                "need the translate service")
+        # validate BEFORE logging — a rejected write must never poison
+        # the shared writelog (core/field.py gives the local WAL the
+        # same guarantee)
+        fld = self.api.holder.index(index).field(field)
+        for v in values or []:
+            fld.to_stored(v)
+        by_shard: Dict[int, Tuple[list, list]] = {}
+        for c, v in zip(cols or [], values or []):
+            ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
+            ent[0].append(int(c))
+            ent[1].append(v)
+        total = 0
+        for shard, (cs, vs) in sorted(by_shard.items()):
+            self.wl.append(index, shard,
+                           {"k": "vals", "f": field, "c": cs, "v": vs})
+            total += self.api.import_values(index, field, cols=cs, values=vs)
+            self.maybe_snapshot(index, shard)
+        return total
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: Dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
+        self.wl.append(index, shard, {
+            "k": "roaring", "f": field, "s": shard, "x": int(clear),
+            "views": {v: base64.b64encode(b).decode()
+                      for v, b in views.items()}})
+        self.api.import_roaring(index, field, shard, views, clear=clear)
+        self.maybe_snapshot(index, shard)
+
+    # -- passthroughs so the stock HTTP handler can serve a computer -------
+
+    @property
+    def holder(self):
+        return self.api.holder
+
+    @property
+    def transactions(self):
+        return self.api.transactions
+
+    @property
+    def history(self):
+        return self.api.history
+
+    def query(self, index: str, pql: str, shards=None):
+        # direct (non-wire) queries, e.g. health checks against one node
+        return self.api.query(index, pql, shards=shards)
+
+    def schema(self) -> List[dict]:
+        return self.api.schema()
+
+    def status(self) -> dict:
+        return {"nodeID": self.node.id,
+                "directiveVersion": self.directive_version,
+                "assigned": sorted([t, s] for t, s in self.assigned)}

@@ -1,0 +1,250 @@
+"""Controller: the DAX control plane.
+
+Reference: dax/controller/controller.go:30 — worker registry, balancer
+assigning shards to compute nodes, directive push (:1033 sendDirectives),
+poller health checks (dax/controller/poller/). The balancer here is
+*sticky* jump-hash: a shard keeps its owner until that owner dies, then
+reassigns over the live set — the minimal-movement property the
+reference's balancer also optimizes for. Schema changes and assignment
+changes both bump the directive version and push.
+
+Locking: registry/assignment mutations run under one lock, but directive
+DELIVERY always happens outside it (a hung computer must never stall the
+whole control plane — queries need assignment()/live_nodes() concurrently).
+Push failures feed back as deaths, which reassign and push again until
+the fleet converges.
+
+The registry is in-memory plus the shared-FS writelog as the durable
+source of truth for WHICH shards exist (cold start rediscovers them from
+the logs — reference: controller persistence in dax/controller/sqldb/).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from pilosa_tpu.cluster.client import InternalClient, NodeDownError
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.hashing import fnv64a, jump_hash
+from pilosa_tpu.dax.directive import Directive, METHOD_FULL
+from pilosa_tpu.dax.storage import WriteLogger
+
+
+class Controller:
+    def __init__(self, shared_dir: str, client: Optional[InternalClient] = None,
+                 dead_after_s: float = 5.0):
+        self.client = client or InternalClient()
+        self.dead_after_s = dead_after_s
+        self.wl = WriteLogger(shared_dir)
+        self._lock = threading.RLock()
+        self.nodes: Dict[str, Node] = {}
+        self.last_seen: Dict[str, float] = {}
+        self.dead: Set[str] = set()
+        self.assign: Dict[Tuple[str, int], str] = {}
+        self.schema: List[dict] = []
+        self.version = 0
+        # in-process computers (harness mode): directive delivery by
+        # direct call instead of HTTP when registered with an object
+        self._local: Dict[str, object] = {}
+
+    # -- registry (reference: controller.go RegisterNode + poller) ---------
+
+    def register(self, node: Node, computer: Optional[object] = None) -> None:
+        with self._lock:
+            self.nodes[node.id] = node
+            self.last_seen[node.id] = time.time()
+            self.dead.discard(node.id)
+            if computer is not None:
+                self._local[node.id] = computer
+            self.version += 1
+        self._deliver([node.id])
+
+    def checkin(self, node_id: str) -> None:
+        resync = False
+        with self._lock:
+            if node_id in self.nodes:
+                self.last_seen[node_id] = time.time()
+                if node_id in self.dead:
+                    # back from the dead: full directive resyncs it
+                    self.dead.discard(node_id)
+                    self.version += 1
+                    resync = True
+        if resync:
+            self._deliver([node_id])
+
+    def live_ids(self) -> Set[str]:
+        with self._lock:
+            return set(self.nodes) - self.dead
+
+    def live_nodes(self) -> List[Node]:
+        with self._lock:
+            return [n for i, n in self.nodes.items() if i not in self.dead]
+
+    def poll(self, now: Optional[float] = None) -> List[str]:
+        """Health sweep (reference: dax/controller/poller): nodes silent
+        past the deadline die and their shards reassign. Returns newly
+        dead node ids."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            newly = [i for i in self.nodes
+                     if i not in self.dead
+                     and now - self.last_seen[i] > self.dead_after_s]
+        for i in newly:
+            self.mark_dead(i)
+        return newly
+
+    def mark_dead(self, node_id: str) -> None:
+        self._deliver(self._bury(node_id))
+
+    def _bury(self, node_id: str) -> List[str]:
+        """Mark dead + reassign its shards under the lock; returns the
+        owners whose directives must be (re)delivered."""
+        with self._lock:
+            if node_id in self.dead or node_id not in self.nodes:
+                return []
+            self.dead.add(node_id)
+            self._local.pop(node_id, None)
+            touched: Set[str] = set()
+            for key in [k for k, nid in self.assign.items()
+                        if nid == node_id]:
+                owner = self._pick(key)
+                if owner is not None:
+                    self.assign[key] = owner
+                    touched.add(owner)
+            self.version += 1
+            return sorted(touched)
+
+    # -- schema (pushed with every directive) ------------------------------
+
+    def create_table(self, name: str, options: Optional[dict] = None,
+                     fields: Optional[List[dict]] = None) -> None:
+        with self._lock:
+            if any(t["index"] == name for t in self.schema):
+                raise ValueError(f"table {name!r} already exists")
+            self.schema.append({"index": name, "options": options or {},
+                                "fields": fields or []})
+            self.version += 1
+        self._deliver(sorted(self.live_ids()))
+
+    def create_field(self, index: str, field: str,
+                     options: Optional[dict] = None) -> None:
+        with self._lock:
+            for t in self.schema:
+                if t["index"] == index:
+                    t.setdefault("fields", []).append(
+                        {"name": field, "options": options or {}})
+                    self.version += 1
+                    break
+            else:
+                raise KeyError(index)
+        self._deliver(sorted(self.live_ids()))
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            self.schema = [t for t in self.schema if t["index"] != name]
+            self.assign = {k: v for k, v in self.assign.items()
+                           if k[0] != name}
+            self.version += 1
+        # the shared-FS logs/snapshots ARE the table's durable data —
+        # drop them too or a re-created table resurrects the old rows
+        # (and recover_from_logs would re-assign phantom shards)
+        self.wl.drop_table(name)
+        from pilosa_tpu.dax.storage import Snapshotter
+
+        Snapshotter(self.wl.root.rsplit("/wl", 1)[0]).drop_table(name)
+        self._deliver(sorted(self.live_ids()))
+
+    # -- placement (reference: dax/controller/balancer/) -------------------
+
+    def _pick(self, key: Tuple[str, int]) -> Optional[str]:
+        live = sorted((set(self.nodes) - self.dead))
+        if not live:
+            return None
+        h = fnv64a(f"{key[0]}/{key[1]}".encode())
+        return live[jump_hash(h, len(live))]
+
+    def ensure_shard(self, table: str, shard: int) -> Node:
+        """Owner of (table, shard), assigning (and pushing a directive to
+        the new owner) if unassigned — how shards come into existence on
+        the write path."""
+        push_to: Optional[str] = None
+        with self._lock:
+            key = (table, shard)
+            nid = self.assign.get(key)
+            if nid is None or nid in self.dead:
+                nid = self._pick(key)
+                if nid is None:
+                    raise NodeDownError("no live compute nodes")
+                self.assign[key] = nid
+                self.version += 1
+                push_to = nid
+            node = self.nodes[nid]
+        if push_to is not None:
+            self._deliver([push_to])
+        return node
+
+    def recover_from_logs(self) -> None:
+        """Cold start: the shared-FS writelog is the durable record of
+        which shards exist — assign them all (reference: controller boot
+        reading its persisted registry). Tables absent from the schema
+        are skipped (their logs are garbage awaiting cleanup)."""
+        with self._lock:
+            known = {t["index"] for t in self.schema}
+            for table in self.wl.tables():
+                if table not in known:
+                    continue
+                for shard in self.wl.shards(table):
+                    key = (table, shard)
+                    if key not in self.assign:
+                        owner = self._pick(key)
+                        if owner is not None:
+                            self.assign[key] = owner
+            self.version += 1
+        self._deliver(sorted(self.live_ids()))
+
+    # -- topology for the queryer ------------------------------------------
+
+    def assignment(self) -> Dict[Tuple[str, int], str]:
+        with self._lock:
+            return dict(self.assign)
+
+    def shards_of(self, table: str) -> Set[int]:
+        with self._lock:
+            return {s for (t, s) in self.assign if t == table}
+
+    # -- directive delivery (reference: controller.go:1033 sendDirectives) -
+
+    def _directive_for(self, node_id: str) -> Directive:
+        return Directive(
+            version=self.version, method=METHOD_FULL,
+            schema=[dict(t) for t in self.schema],
+            assigned=sorted(k for k, nid in self.assign.items()
+                            if nid == node_id))
+
+    def _deliver(self, node_ids: List[str]) -> None:
+        """Send directives OUTSIDE the lock; failures mark nodes dead,
+        whose shards reassign and push again, until the fleet converges
+        (push failure IS failure detection — the poller shortcut)."""
+        pending = list(node_ids)
+        for _ in range(len(self.nodes) + 2):  # bounded by fleet size
+            if not pending:
+                return
+            with self._lock:
+                batch = [(nid, self.nodes[nid],
+                          self._directive_for(nid), self._local.get(nid))
+                         for nid in dict.fromkeys(pending)
+                         if nid in self.nodes and nid not in self.dead]
+            failed: List[str] = []
+            for nid, node, d, local in batch:
+                try:
+                    if local is not None:
+                        local.apply_directive(d.to_json())
+                    else:
+                        self.client.send_directive(node, d.to_json())
+                except (NodeDownError, OSError):
+                    failed.append(nid)
+            pending = []
+            for nid in failed:
+                pending.extend(self._bury(nid))

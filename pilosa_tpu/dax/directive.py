@@ -1,0 +1,48 @@
+"""Directives: the controller -> computer assignment protocol.
+
+Reference: dax/directive.go:8 (Directive with method full/diff/reset),
+applied by computers at api_directive.go:21 ApplyDirective. A directive
+carries the whole schema plus THIS node's shard assignment; versions are
+monotonic and a computer rejects regressions (api_directive.go:26-41).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+METHOD_FULL = "full"
+METHOD_DIFF = "diff"
+METHOD_RESET = "reset"
+
+
+@dataclasses.dataclass
+class Directive:
+    version: int
+    method: str = METHOD_FULL
+    # full schema snapshot: [{"index": name, "options": {...},
+    #   "fields": [{"name": n, "options": {...}}, ...]}, ...]
+    schema: List[dict] = dataclasses.field(default_factory=list)
+    # THIS computer's assignment: [(table, shard), ...]
+    assigned: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "version": self.version,
+            "method": self.method,
+            "schema": self.schema,
+            "assigned": [[t, s] for t, s in self.assigned],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Directive":
+        return cls(version=int(d["version"]),
+                   method=d.get("method", METHOD_FULL),
+                   schema=list(d.get("schema", [])),
+                   assigned=[(t, int(s)) for t, s in d.get("assigned", [])])
+
+    def assigned_by_table(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for t, s in self.assigned:
+            out.setdefault(t, []).append(s)
+        return out

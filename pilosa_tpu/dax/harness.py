@@ -1,0 +1,73 @@
+"""In-process DAX cluster for tests (reference: dax/test/dax.go).
+
+Boots a Controller, N HTTP-served Computers, and a Queryer sharing one
+filesystem directory. Kill a computer with :meth:`kill` — the poller (or
+the next failed push) reassigns its shards and the new owners rebuild
+from the shared writelog/snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.dax.computer import Computer
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.dax.queryer import Queryer
+from pilosa_tpu.server.http import serve
+
+
+class DaxCluster:
+    def __init__(self, n: int, shared_dir: Optional[str] = None,
+                 dead_after_s: float = 5.0, snapshot_every: int = 256,
+                 http: bool = True):
+        self.dir = shared_dir or tempfile.mkdtemp(prefix="dax_")
+        os.makedirs(self.dir, exist_ok=True)
+        self.controller = Controller(self.dir, dead_after_s=dead_after_s)
+        self.computers: List[Computer] = []
+        self._servers = []
+        for i in range(n):
+            comp = Computer(f"compute{i}", self.dir,
+                            snapshot_every=snapshot_every)
+            if http:
+                srv, _ = serve(comp, port=0, background=True)
+                host, port = srv.server_address[:2]
+                comp.node = Node(id=comp.node.id,
+                                 uri=f"http://{host}:{port}")
+                self._servers.append(srv)
+            else:
+                self._servers.append(None)
+            self.computers.append(comp)
+            # register with the in-process object so directive delivery
+            # works even without HTTP; queries go over HTTP regardless
+            self.controller.register(comp.node, computer=comp)
+        self.queryer = Queryer(self.controller)
+
+    def kill(self, i: int) -> None:
+        """SIGKILL analog: close the listener AND mark dead (the poller
+        path is exercised separately via controller.poll)."""
+        srv = self._servers[i]
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            self._servers[i] = None
+        self.controller._local.pop(self.computers[i].node.id, None)
+        self.controller.mark_dead(self.computers[i].node.id)
+
+    def silence(self, i: int) -> None:
+        """Stop serving WITHOUT telling the controller — death must be
+        detected by the poller (missed checkins)."""
+        srv = self._servers[i]
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            self._servers[i] = None
+        self.controller._local.pop(self.computers[i].node.id, None)
+
+    def close(self) -> None:
+        for srv in self._servers:
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()

@@ -1,0 +1,162 @@
+"""Queryer: the stateless DAX query front-end.
+
+Reference: dax/queryer/orchestrator.go:83 — a fork of the executor's
+plan-walk that asks the Controller for shard->node topology instead of
+the etcd snapshot (Topologer :43). Here the fork is free: the classic
+ClusterExecutor takes its topology through a snapshot function, so the
+Queryer feeds it a controller-backed snapshot and reuses the whole
+fan-out/reduce/translate machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.executor import ClusterExecutor
+from pilosa_tpu.cluster.topology import ClusterSnapshot, Node
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql.result import result_to_json
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class DaxSnapshot(ClusterSnapshot):
+    """Controller-driven placement: assigned shards resolve to their
+    sticky owner; anything else falls back to jump hash over the live
+    computers (new shards land where ensure_shard would put them)."""
+
+    def __init__(self, nodes: List[Node],
+                 assign: Dict[Tuple[str, int], str]):
+        super().__init__(nodes, replica_n=1)
+        self._assign = assign
+        self._by_id = {n.id: n for n in nodes}
+
+    def shard_nodes(self, index: str, shard: int) -> List[Node]:
+        nid = self._assign.get((index, shard))
+        if nid is not None and nid in self._by_id:
+            return [self._by_id[nid]]
+        return super().shard_nodes(index, shard)
+
+
+class Queryer:
+    def __init__(self, controller: Controller,
+                 client: Optional[InternalClient] = None):
+        self.controller = controller
+        self.client = client or controller.client
+        self.holder = Holder()  # schema-only mirror; no data lives here
+        self.executor = ClusterExecutor(
+            "queryer", self.holder, self.client, self._snapshot,
+            controller.shards_of,
+            live_fn=controller.live_ids)
+
+    def _snapshot(self) -> DaxSnapshot:
+        return DaxSnapshot(self.controller.live_nodes(),
+                           self.controller.assignment())
+
+    def _sync_schema(self) -> None:
+        """Mirror the controller's schema into the local (data-free)
+        holder — the executor needs Index/Field objects for planning and
+        translation routing."""
+        from pilosa_tpu.core.schema import (
+            FieldOptions, FieldType, IndexOptions,
+        )
+
+        for t in self.controller.schema:
+            name = t["index"]
+            if name not in self.holder.indexes:
+                o = t.get("options") or {}
+                self.holder.create_index(name, IndexOptions(
+                    keys=bool(o.get("keys", False)),
+                    track_existence=bool(o.get("trackExistence", True))))
+            idx = self.holder.index(name)
+            for f in t.get("fields", []):
+                if f["name"] not in idx.fields:
+                    o = dict(f.get("options") or {})
+                    fo = FieldOptions(
+                        type=FieldType(o.get("type", "set")),
+                        keys=bool(o.get("keys", False)),
+                        min=o.get("min"), max=o.get("max"),
+                        base=int(o.get("base", 0)),
+                        scale=int(o.get("scale", 0)),
+                        time_unit=o.get("timeUnit", "s"),
+                        time_quantum=o.get("timeQuantum", ""),
+                        ttl_seconds=int(o.get("ttl", 0)))
+                    idx.create_field(f["name"], fo)
+        for name in list(self.holder.indexes):
+            if not any(t["index"] == name for t in self.controller.schema):
+                self.holder.delete_index(name)
+
+    # -- queries -----------------------------------------------------------
+
+    def query(self, index: str, pql: str,
+              shards: Optional[Sequence[int]] = None) -> List:
+        self._sync_schema()
+        q = parse(pql)
+        # writes to fresh shards must be assigned before fan-out
+        for call in q.calls:
+            inner = call
+            while inner.name == "Options":
+                inner = inner.children[0]
+            if inner.name in ("Set", "Clear"):
+                col = inner.arg("_col")
+                if isinstance(col, int):
+                    self.controller.ensure_shard(index, col // SHARD_WIDTH)
+        return self.executor.execute(index, q, shards=shards)
+
+    def query_json(self, index: str, pql: str) -> dict:
+        return {"results": [result_to_json(r)
+                            for r in self.query(index, pql)]}
+
+    # -- imports (routed to shard owners) ----------------------------------
+
+    def import_bits(self, index: str, field: str, rows=None, cols=None,
+                    clear: bool = False) -> int:
+        self._sync_schema()
+        by_shard: Dict[int, Tuple[list, list]] = {}
+        for r, c in zip(rows or [], cols or []):
+            ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
+            ent[0].append(int(r))
+            ent[1].append(int(c))
+        total = 0
+        for shard, (rs, cs) in sorted(by_shard.items()):
+            node = self.controller.ensure_shard(index, shard)
+            total += self._owner_call(
+                node, "import_bits", index, field,
+                {"field": field, "rows": rs, "cols": cs,
+                 "clear": clear, "remote": True}).get("changed", 0)
+        return total
+
+    def import_values(self, index: str, field: str, cols=None,
+                      values=None) -> int:
+        self._sync_schema()
+        by_shard: Dict[int, Tuple[list, list]] = {}
+        for c, v in zip(cols or [], values or []):
+            ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
+            ent[0].append(int(c))
+            ent[1].append(v)
+        total = 0
+        for shard, (cs, vs) in sorted(by_shard.items()):
+            node = self.controller.ensure_shard(index, shard)
+            total += self._owner_call(
+                node, "import_values", index, field,
+                {"field": field, "cols": cs, "values": vs,
+                 "remote": True}).get("imported", 0)
+        return total
+
+    def _owner_call(self, node: Node, kind: str, index: str, field: str,
+                    payload: dict) -> dict:
+        local = self.controller._local.get(node.id)
+        if local is not None:
+            if kind == "import_bits":
+                n = local.import_bits(index, field, rows=payload["rows"],
+                                      cols=payload["cols"],
+                                      clear=payload["clear"], remote=True)
+                return {"changed": n}
+            n = local.import_values(index, field, cols=payload["cols"],
+                                    values=payload["values"], remote=True)
+            return {"imported": n}
+        if kind == "import_bits":
+            return self.client.import_bits(node, index, field, payload)
+        return self.client.import_values(node, index, field, payload)

@@ -1,0 +1,163 @@
+"""Writelogger + Snapshotter: DAX durability on a shared filesystem.
+
+Reference: dax/writelogger/writelogger.go:22 (append-only op logs per
+table/partition; durability = the log, computers are stateless) and
+dax/snapshotter/snapshotter.go (versioned shard snapshots; resume =
+snapshot + log replay, dax/storage/). Layout:
+
+    <root>/wl/<table>/<shard>.jsonl      one JSON op per line
+    <root>/snap/<table>/<shard>.<v>.npz  planes at log version v
+
+A snapshot's version is the log offset (op count) it covers; replay
+starts after it. Ops are either replayable PQL write calls or bulk
+imports — both deterministic, so replay through the normal engine write
+path reproduces the planes bit for bit.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class WriteLogger:
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "wl")
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        # per-(table, shard) op counts, counted from disk once then
+        # maintained incrementally — appends must stay O(1), not re-read
+        # the log (the write path calls length after every op)
+        self._len: Dict[Tuple[str, int], int] = {}
+
+    def _path(self, table: str, shard: int) -> str:
+        d = os.path.join(self.root, table)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{shard}.jsonl")
+
+    def _count_locked(self, table: str, shard: int) -> int:
+        key = (table, shard)
+        n = self._len.get(key)
+        if n is None:
+            p = self._path(table, shard)
+            n = 0
+            if os.path.exists(p):
+                with open(p) as f:
+                    n = sum(1 for _ in f)
+            self._len[key] = n
+        return n
+
+    def append(self, table: str, shard: int, op: dict) -> int:
+        """Durably append one op; returns the new log length (the version
+        a subsequent snapshot would cover)."""
+        line = json.dumps(op, separators=(",", ":")) + "\n"
+        with self._lock:
+            n = self._count_locked(table, shard)
+            with open(self._path(table, shard), "a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+            self._len[(table, shard)] = n + 1
+            return n + 1
+
+    def length(self, table: str, shard: int) -> int:
+        with self._lock:
+            return self._count_locked(table, shard)
+
+    def drop_table(self, table: str) -> None:
+        import shutil
+
+        with self._lock:
+            self._len = {k: v for k, v in self._len.items()
+                         if k[0] != table}
+            d = os.path.join(self.root, table)
+            if os.path.isdir(d):
+                shutil.rmtree(d, ignore_errors=True)
+
+    def replay(self, table: str, shard: int,
+               from_version: int = 0) -> Iterator[dict]:
+        p = self._path(table, shard)
+        if not os.path.exists(p):
+            return
+        with open(p) as f:
+            for i, line in enumerate(f):
+                if i >= from_version and line.strip():
+                    yield json.loads(line)
+
+    def shards(self, table: str) -> List[int]:
+        d = os.path.join(self.root, table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(int(f[:-6]) for f in os.listdir(d)
+                      if f.endswith(".jsonl"))
+
+    def tables(self) -> List[str]:
+        return sorted(t for t in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, t)))
+
+
+class Snapshotter:
+    """Versioned per-(table, shard) plane snapshots (compaction points
+    for the writelog)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "snap")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _dir(self, table: str) -> str:
+        d = os.path.join(self.root, table)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def write(self, table: str, shard: int, version: int,
+              arrays: Dict[str, np.ndarray]) -> None:
+        """Atomic write of the shard's planes at log ``version``; older
+        versions of the same shard are pruned (the reference's
+        snapshotter keeps the latest version per shard)."""
+        d = self._dir(table)
+        final = os.path.join(d, f"{shard}.{version}.npz")
+        tmp = final + ".tmp"
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        for fname in os.listdir(d):
+            if fname.startswith(f"{shard}.") and fname.endswith(".npz") \
+                    and fname != f"{shard}.{version}.npz":
+                try:
+                    os.remove(os.path.join(d, fname))
+                except OSError:
+                    pass
+
+    def drop_table(self, table: str) -> None:
+        import shutil
+
+        d = os.path.join(self.root, table)
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    def latest(self, table: str, shard: int
+               ) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
+        d = os.path.join(self.root, table)
+        if not os.path.isdir(d):
+            return None
+        best = -1
+        for fname in os.listdir(d):
+            if fname.startswith(f"{shard}.") and fname.endswith(".npz"):
+                try:
+                    v = int(fname.split(".")[1])
+                except (IndexError, ValueError):
+                    continue
+                best = max(best, v)
+        if best < 0:
+            return None
+        with np.load(os.path.join(d, f"{shard}.{best}.npz")) as z:
+            return best, {k: z[k] for k in z.files}

@@ -72,6 +72,8 @@ _ROUTES = [
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
     ("GET", re.compile(r"^/query-history$"), "get_query_history"),
     ("GET", re.compile(r"^/index/([^/]+)/mutex-check$"), "get_mutex_check"),
+    # DAX directive push (reference: dax computer /directive endpoint)
+    ("POST", re.compile(r"^/directive$"), "post_directive"),
     # cluster transactions (reference: http_handler.go:528-533)
     ("POST", re.compile(r"^/transaction/?$"), "post_transaction"),
     ("GET", re.compile(r"^/transaction/([^/]+)$"), "get_transaction"),
@@ -342,6 +344,14 @@ class Handler(BaseHTTPRequestHandler):
         has no peers)."""
         if not hasattr(self.api, "query_remote"):
             raise KeyError("not a cluster node")
+
+    def post_directive(self):
+        """DAX assignment push (reference: api_directive.go:21
+        ApplyDirective); only compute nodes implement it."""
+        apply = getattr(self.api, "apply_directive", None)
+        if apply is None:
+            raise KeyError("not a DAX compute node")
+        self._send(200, apply(self._json_body()))
 
     def post_internal_query(self, index: str):
         self._node_only()

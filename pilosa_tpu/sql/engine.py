@@ -147,14 +147,82 @@ class SQLEngine:
             ["_id"] + [n for n in idx.fields if not n.startswith("_")])
         if "_id" not in cols:
             raise SQLError("INSERT requires the _id column")
-        n = 0
+        records = []
         for row_exprs in ins.rows:
             if len(row_exprs) != len(cols):
                 raise SQLError("INSERT value count does not match column list")
-            values = {c: eval_expr(e, {}) for c, e in zip(cols, row_exprs)}
-            self._upsert_record(idx, values, replace=ins.replace)
-            n += 1
-        return SQLResult(schema=[], data=[], changed=n)
+            records.append({c: eval_expr(e, {})
+                            for c, e in zip(cols, row_exprs)})
+        if ins.replace:
+            # REPLACE needs a per-record existing-rows lookup + clear
+            for values in records:
+                self._upsert_record(idx, values, replace=True)
+        else:
+            self._batch_upsert(idx, records)
+        return SQLResult(schema=[], data=[], changed=len(records))
+
+    def _batch_upsert(self, idx, records: List[dict]) -> None:
+        """Accumulate a whole statement's records into ONE api import per
+        field (the reference lowers inserts to the bulk Importer the same
+        way, importer.go:13) — each api call is a write-lock + WAL
+        group-commit and, on a cluster, an HTTP fan-out, so per-record
+        calls would cost N*F round trips instead of F."""
+        keyed = idx.options.keys
+
+        def ckey(rec):
+            return str(rec["_id"]) if keyed else int(rec["_id"])
+
+        setacc: Dict[str, dict] = {}
+        valacc: Dict[str, dict] = {}
+        lonely = []  # records whose every field is NULL/empty: exists-only
+        for rec in records:
+            c = ckey(rec)
+            any_field = False
+            for name, v in rec.items():
+                if name == "_id" or v is None:
+                    continue
+                field = idx.field(name)
+                t = field.options.type
+                if t.is_bsi:
+                    a = valacc.setdefault(name, {"cols": [], "values": []})
+                    a["cols"].append(c)
+                    a["values"].append(v)
+                    any_field = True
+                    continue
+                vals = v if isinstance(v, list) else [v]
+                if t == FieldType.BOOL:
+                    vals = [1 if v else 0]
+                if not vals:
+                    continue  # empty set literal writes no bits
+                a = setacc.setdefault(name, {"rows": [], "cols": []})
+                for item in vals:
+                    a["rows"].append(item)
+                    a["cols"].append(c)
+                any_field = True
+            if not any_field:
+                lonely.append(c)
+
+        def colkw(cs):
+            return {"col_keys": [str(x) for x in cs]} if keyed \
+                else {"cols": [int(x) for x in cs]}
+
+        for name, a in valacc.items():
+            self.api.import_values(idx.name, name, values=a["values"],
+                                   **colkw(a["cols"]))
+        for name, a in setacc.items():
+            field = idx.field(name)
+            if field.options.keys:
+                self.api.import_bits(
+                    idx.name, name, rows=[],
+                    row_keys=[str(r) for r in a["rows"]],
+                    **colkw(a["cols"]))
+            else:
+                self.api.import_bits(
+                    idx.name, name, rows=[int(r) for r in a["rows"]],
+                    **colkw(a["cols"]))
+        if lonely and idx.options.track_existence:
+            self.api.import_bits(idx.name, "_exists",
+                                 rows=[0] * len(lonely), **colkw(lonely))
 
     def _upsert_record(self, idx, values: dict, replace: bool = False) -> None:
         """Write one record THROUGH the api import surface so DML routes
@@ -173,10 +241,7 @@ class SQLEngine:
 
         set_fields = [(n, v) for n, v in values.items()
                       if n != "_id" and v is not None]
-        if not set_fields:
-            # the record exists even when every field is NULL
-            self.api.import_bits(index, "_exists", rows=[0], **one_col(1))
-            return
+        imported = False
         for name, v in set_fields:
             field = idx.field(name)
             t = field.options.type
@@ -184,10 +249,12 @@ class SQLEngine:
                 self.api.import_values(index, name, values=[v],
                                        **({"col_keys": col_keys}
                                           if col_keys else {"cols": cols}))
+                imported = True
                 continue
             if t == FieldType.BOOL:
                 self.api.import_bits(index, name,
                                      rows=[1 if v else 0], **one_col(1))
+                imported = True
                 continue
             vals = v if isinstance(v, list) else [v]
             if replace and t not in (FieldType.MUTEX, FieldType.BOOL):
@@ -205,6 +272,8 @@ class SQLEngine:
                         row_keys=([str(r) for r in existing]
                                   if field.options.keys else None),
                         clear=True, **one_col(len(existing)))
+            if not vals:
+                continue  # empty set literal writes no bits
             if field.options.keys:
                 self.api.import_bits(index, name, rows=[],
                                      row_keys=[str(i) for i in vals],
@@ -213,6 +282,11 @@ class SQLEngine:
                 self.api.import_bits(index, name,
                                      rows=[int(i) for i in vals],
                                      **one_col(len(vals)))
+            imported = True
+        if not imported and idx.options.track_existence:
+            # the record exists even when every field is NULL or an
+            # empty set literal
+            self.api.import_bits(index, "_exists", rows=[0], **one_col(1))
 
     def _bulk_insert(self, bi: ast.BulkInsert) -> SQLResult:
         """CSV bulk load (reference: sql3 BULK INSERT with MAP ordinals,
@@ -230,6 +304,7 @@ class SQLEngine:
         else:
             f = open(bi.source, newline="")
         n = 0
+        pending: List[dict] = []
         with f:
             rows = iter(csv.reader(f))
             if bi.options.get("HEADER_ROW"):
@@ -251,8 +326,13 @@ class SQLEngine:
                             f"references position {pos} (use "
                             f"ALLOW_MISSING_VALUES to tolerate)")
                     values[cname] = _coerce(rec[pos], typ)
-                self._upsert_record(idx, values)
+                pending.append(values)
                 n += 1
+                if len(pending) >= 8192:  # bounded batches, F calls each
+                    self._batch_upsert(idx, pending)
+                    pending = []
+            if pending:
+                self._batch_upsert(idx, pending)
         return SQLResult(schema=[], data=[], changed=n)
 
     def _delete(self, d: ast.DeleteStatement) -> SQLResult:

@@ -1,0 +1,178 @@
+"""DAX control-plane tests (reference: dax/test/dax.go harness and the
+controller/computer/queryer behaviors of dax/).
+
+The VERDICT r3 #4 done-criterion drives the shape: kill a compute node
+in the harness, shards get reassigned, and the query returns COMPLETE
+results (rebuilt from the shared writelog/snapshots)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.dax.directive import Directive
+from pilosa_tpu.dax.harness import DaxCluster
+from pilosa_tpu.dax.storage import Snapshotter, WriteLogger
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def dax(tmp_path):
+    c = DaxCluster(3, shared_dir=str(tmp_path), snapshot_every=8)
+    yield c
+    c.close()
+
+
+def _fill(dax, index="t", rows=3, per_shard=40, shards=4):
+    dax.controller.create_table(index, {}, [
+        {"name": "f", "options": {"type": "set"}},
+        {"name": "n", "options": {"type": "int"}},
+    ])
+    rng = np.random.default_rng(5)
+    oracle = {r: set() for r in range(rows)}
+    vals = {}
+    for s in range(shards):
+        rs, cs = [], []
+        for _ in range(per_shard):
+            r = int(rng.integers(0, rows))
+            c = s * SHARD_WIDTH + int(rng.integers(0, SHARD_WIDTH))
+            rs.append(r)
+            cs.append(c)
+            oracle[r].add(c)
+        dax.queryer.import_bits(index, "f", rows=rs, cols=cs)
+        vcols = [s * SHARD_WIDTH + i for i in range(10)]
+        vvals = [int(rng.integers(-50, 50)) for _ in vcols]
+        dax.queryer.import_values(index, "n", cols=vcols, values=vvals)
+        for c, v in zip(vcols, vvals):
+            vals[c] = v
+    return oracle, vals
+
+
+class TestDaxBasics:
+    def test_queries_match_oracle(self, dax):
+        oracle, vals = _fill(dax)
+        for r, cols in oracle.items():
+            assert dax.queryer.query("t", f"Count(Row(f={r}))")[0] == len(cols)
+        assert dax.queryer.query("t", "Sum(field=n)")[0].val == \
+            sum(vals.values())
+
+    def test_shards_spread_across_computers(self, dax):
+        _fill(dax)
+        owners = {nid for (t, s), nid in dax.controller.assignment().items()}
+        assert len(owners) >= 2, "balancer left everything on one node"
+        # each computer holds only its assigned shards
+        for comp in dax.computers:
+            local = comp.api.holder.indexes["t"].shards()
+            assigned = {s for (t, s) in comp.assigned if t == "t"}
+            assert local <= assigned | {0}
+
+    def test_writes_are_logged_before_apply(self, dax, tmp_path):
+        _fill(dax)
+        wl = WriteLogger(str(tmp_path))
+        assert wl.shards("t"), "writelog is empty"
+        total_ops = sum(wl.length("t", s) for s in wl.shards("t"))
+        assert total_ops > 0
+
+    def test_directive_version_regression_rejected(self, dax):
+        _fill(dax)
+        comp = dax.computers[0]
+        v = comp.directive_version
+        stale = Directive(version=v - 1, schema=[], assigned=[])
+        out = comp.apply_directive(stale.to_json())
+        assert not out["applied"]
+        assert comp.directive_version == v
+
+
+class TestDaxFailover:
+    def test_kill_computer_reassigns_and_data_survives(self, dax):
+        """The headline behavior: kill a node; shards reassign; queries
+        return complete results rebuilt from writelog + snapshots."""
+        oracle, vals = _fill(dax)
+        before = {r: dax.queryer.query("t", f"Count(Row(f={r}))")[0]
+                  for r in oracle}
+        # kill the busiest computer
+        counts = {}
+        for (t, s), nid in dax.controller.assignment().items():
+            counts[nid] = counts.get(nid, 0) + 1
+        victim = max(counts, key=counts.get)
+        vi = next(i for i, c in enumerate(dax.computers)
+                  if c.node.id == victim)
+        dax.kill(vi)
+        # every shard has a live owner now
+        for key, nid in dax.controller.assignment().items():
+            assert nid != victim
+        after = {r: dax.queryer.query("t", f"Count(Row(f={r}))")[0]
+                 for r in oracle}
+        assert after == before, "data lost in failover"
+        assert dax.queryer.query("t", "Sum(field=n)")[0].val == \
+            sum(vals.values())
+        # and writes keep working post-failover
+        newcol = 7 * SHARD_WIDTH + 1
+        dax.queryer.query("t", f"Set({newcol}, f=0)")
+        assert dax.queryer.query("t", "Count(Row(f=0))")[0] == \
+            before[0] + 1
+
+    def test_poller_detects_silent_death(self, dax):
+        oracle, _ = _fill(dax)
+        victim = dax.computers[1].node.id
+        dax.silence(1)
+        # poller hasn't run: node still considered live
+        assert victim in dax.controller.live_ids()
+        # the victim stops checking in; the others keep heartbeating
+        dax.controller.last_seen[victim] -= 3600
+        for comp in dax.computers:
+            if comp.node.id != victim:
+                dax.controller.checkin(comp.node.id)
+        newly = dax.controller.poll()
+        assert victim in newly
+        assert victim not in dax.controller.live_ids()
+        for r, cols in oracle.items():
+            assert dax.queryer.query("t", f"Count(Row(f={r}))")[0] == len(cols)
+
+    def test_snapshot_compaction_and_resume(self, dax, tmp_path):
+        """Past the op threshold a shard snapshots; a new owner resumes
+        from snapshot + tail replay, not a full log replay."""
+        dax.controller.create_table("s", {}, [
+            {"name": "f", "options": {"type": "set"}}])
+        for k in range(20):  # snapshot_every=8 -> snapshots exist
+            dax.queryer.query("s", f"Set({k}, f=1)")
+        snap = Snapshotter(str(tmp_path))
+        assert snap.latest("s", 0) is not None, "no snapshot written"
+        version, arrays = snap.latest("s", 0)
+        assert version >= 8
+        owner = dax.controller.assignment()[("s", 0)]
+        oi = next(i for i, c in enumerate(dax.computers)
+                  if c.node.id == owner)
+        dax.kill(oi)
+        assert dax.queryer.query("s", "Count(Row(f=1))")[0] == 20
+
+    def test_reset_directive_rebuilds_node(self, dax):
+        oracle, _ = _fill(dax)
+        comp = next(c for c in dax.computers
+                    if any(t == "t" for t, s in c.assigned))
+        d = Directive(version=comp.directive_version, method="reset",
+                      schema=[dict(t) for t in dax.controller.schema],
+                      assigned=sorted(comp.assigned))
+        comp.apply_directive(d.to_json())
+        for r, cols in oracle.items():
+            assert dax.queryer.query("t", f"Count(Row(f={r}))")[0] == len(cols)
+
+
+class TestDaxColdStart:
+    def test_controller_recovers_shards_from_logs(self, tmp_path):
+        c1 = DaxCluster(2, shared_dir=str(tmp_path))
+        try:
+            oracle, _ = _fill(c1)
+        finally:
+            c1.close()
+        # a brand-new control plane + computers over the same shared dir
+        c2 = DaxCluster(2, shared_dir=str(tmp_path))
+        try:
+            c2.controller.schema = [
+                {"index": "t", "options": {}, "fields": [
+                    {"name": "f", "options": {"type": "set"}},
+                    {"name": "n", "options": {"type": "int"}}]}]
+            c2.controller.recover_from_logs()
+            for r, cols in oracle.items():
+                assert c2.queryer.query("t", f"Count(Row(f={r}))")[0] == \
+                    len(cols)
+        finally:
+            c2.close()

@@ -350,3 +350,12 @@ class TestReviewRegressions:
         assert api.sql("select sq.price from sq").data == [[5], [9]]
         with pytest.raises(Exception):
             api.sql("select zz.price from sq o")
+
+    def test_insert_empty_set_literal_record_exists(self):
+        """A record whose only non-id value is an empty set literal must
+        still exist (review fix: the _exists bit was skipped)."""
+        api = API()
+        api.sql("create table es (_id id, tag idset)")
+        api.sql("insert into es values (1, [])")
+        assert api.sql("select count(*) from es").data == [[1]]
+        assert api.sql("select _id from es").data == [[1]]
