@@ -352,8 +352,13 @@ def main() -> None:
         t0 = time.perf_counter()
         try:
             cfg(device)
-        except Exception as exc:  # keep the suite going; line is missing
+        except Exception as exc:  # keep the suite going
             print(f"bench: {cfg.__name__} failed: {exc!r}", file=sys.stderr)
+            if cfg is bench_config3:
+                # the driver records the LAST line as the headline; a
+                # failed headline must be visibly failed, not silently
+                # replaced by whichever config printed last
+                _emit(f"c3_groupby_topk_FAILED ({device})", 0.0, "ms", 0.0)
         print(f"bench: {cfg.__name__} wall {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         gc.collect()

@@ -116,9 +116,11 @@ class API:
         finally:
             span.finish()
 
-    def sql(self, query: str):
+    def sql(self, query: str, parsed=None):
         """Execute a SQL statement (reference: server/sql.go:17 execSQL).
-        Returns a pilosa_tpu.sql.SQLResult."""
+        Returns a pilosa_tpu.sql.SQLResult. ``parsed`` reuses a
+        statement the caller already parsed (the authed HTTP handler
+        parses for authorization first)."""
         eng = self._sql_engine
         if eng is None:
             # import deferred to keep API usable without the sql package;
@@ -128,7 +130,7 @@ class API:
         M.REGISTRY.count(M.METRIC_SQL_QUERIES)
         rec = self.history.begin("", query, "sql")
         try:
-            out = eng.query(query)
+            out = eng.query(query, parsed=parsed)
             self.history.end(rec)
             return out
         except Exception as e:

@@ -185,7 +185,7 @@ class ClusterNode:
 
     def query(self, index: str, pql: str,
               shards: Optional[Sequence[int]] = None) -> List[Any]:
-        q = parse(pql)
+        q = parse(pql) if isinstance(pql, str) else pql
         self._check_state(write=any(
             c.name in _WRITE_CALLS for c in q.calls))
         return self.executor.execute(index, q, shards=shards)

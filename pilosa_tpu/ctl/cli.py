@@ -38,10 +38,25 @@ def cmd_server(args) -> int:
 
     api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync)
     api.holder.checkpoint_bytes = cfg.checkpoint_bytes
+    auth = None
+    if cfg.auth_enable:
+        # the formerly-dead auth config now gates every route
+        from pilosa_tpu.server.auth import Auth, Permissions, \
+            parse_permissions
+
+        perms = Permissions()
+        if cfg.auth_permissions_file:
+            with open(cfg.auth_permissions_file) as f:
+                perms = parse_permissions(f.read())
+        if not cfg.auth_secret:
+            raise SystemExit("auth.enable requires auth.secret")
+        auth = Auth(cfg.auth_secret, perms,
+                    allowed_networks=cfg.auth_allowed_networks)
     print(f"pilosa-tpu serving on {cfg.bind}:{cfg.port} "
-          f"(data-dir={cfg.data_dir or '<memory>'})", file=sys.stderr)
+          f"(data-dir={cfg.data_dir or '<memory>'}"
+          f"{', auth on' if auth else ''})", file=sys.stderr)
     serve(api, host=cfg.bind, port=cfg.port,
-          maintenance_interval_s=cfg.ttl_removal_interval_s)
+          maintenance_interval_s=cfg.ttl_removal_interval_s, auth=auth)
     return 0
 
 

@@ -43,6 +43,7 @@ class Computer:
         self.snapshot_every = snapshot_every
         self.directive_version = -1
         self.assigned: Set[Tuple[str, int]] = set()
+        self._last_snap: Dict[Tuple[str, int], int] = {}
         self._exec = Executor(self.api.holder, remote=True)
 
     # -- directive application (reference: api_directive.go:21) ------------
@@ -175,9 +176,19 @@ class Computer:
             raise ValueError(f"unknown writelog op kind {k!r}")
 
     def maybe_snapshot(self, table: str, shard: int) -> None:
+        """Compaction trigger: snapshot once the log has grown
+        snapshot_every ops past the last snapshot (an exact-multiple
+        check would skip forever when multi-op requests stride past the
+        boundary)."""
         n = self.wl.length(table, shard)
-        if n and n % self.snapshot_every == 0:
+        key = (table, shard)
+        last = self._last_snap.get(key)
+        if last is None:
+            last = self.snap.latest_version(table, shard)
+            self._last_snap[key] = last
+        if n - last >= self.snapshot_every:
             self.snap.write(table, shard, n, self._export_shard(table, shard))
+            self._last_snap[key] = n
 
     # -- internal serving surface (same shape as ClusterNode) --------------
 

@@ -37,6 +37,7 @@ class Controller:
                  dead_after_s: float = 5.0):
         self.client = client or InternalClient()
         self.dead_after_s = dead_after_s
+        self.shared_dir = shared_dir
         self.wl = WriteLogger(shared_dir)
         self._lock = threading.RLock()
         self.nodes: Dict[str, Node] = {}
@@ -153,7 +154,7 @@ class Controller:
         self.wl.drop_table(name)
         from pilosa_tpu.dax.storage import Snapshotter
 
-        Snapshotter(self.wl.root.rsplit("/wl", 1)[0]).drop_table(name)
+        Snapshotter(self.shared_dir).drop_table(name)
         self._deliver(sorted(self.live_ids()))
 
     # -- placement (reference: dax/controller/balancer/) -------------------

@@ -94,13 +94,20 @@ class Queryer:
               shards: Optional[Sequence[int]] = None) -> List:
         self._sync_schema()
         q = parse(pql)
-        # writes to fresh shards must be assigned before fan-out
+        # writes to fresh shards must be assigned before fan-out; keyed
+        # columns translate FIRST so the owning shard is known (the
+        # executor would otherwise route the write through the snapshot
+        # fallback and the controller would never learn the shard exists)
         for call in q.calls:
             inner = call
             while inner.name == "Options":
                 inner = inner.children[0]
             if inner.name in ("Set", "Clear"):
                 col = inner.arg("_col")
+                if isinstance(col, str):
+                    ids = self.executor.translator.index_keys(
+                        index, [col], create=True)
+                    col = ids.get(col)
                 if isinstance(col, int):
                     self.controller.ensure_shard(index, col // SHARD_WIDTH)
         return self.executor.execute(index, q, shards=shards)

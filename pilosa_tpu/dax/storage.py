@@ -144,6 +144,20 @@ class Snapshotter:
         if os.path.isdir(d):
             shutil.rmtree(d, ignore_errors=True)
 
+    def latest_version(self, table: str, shard: int) -> int:
+        """Newest snapshot's covered log version (0 = none) — a filename
+        scan, no payload load."""
+        d = os.path.join(self.root, table)
+        best = 0
+        if os.path.isdir(d):
+            for fname in os.listdir(d):
+                if fname.startswith(f"{shard}.") and fname.endswith(".npz"):
+                    try:
+                        best = max(best, int(fname.split(".")[1]))
+                    except (IndexError, ValueError):
+                        continue
+        return best
+
     def latest(self, table: str, shard: int
                ) -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
         d = os.path.join(self.root, table)

@@ -74,6 +74,10 @@ _ROUTES = [
     ("GET", re.compile(r"^/index/([^/]+)/mutex-check$"), "get_mutex_check"),
     # DAX directive push (reference: dax computer /directive endpoint)
     ("POST", re.compile(r"^/directive$"), "post_directive"),
+    # gRPC service over HTTP/1.1 framing (reference: server/grpc.go
+    # service surface; transport documented in server/grpc.py)
+    ("POST", re.compile(r"^/grpc/pilosa\.Pilosa/([A-Za-z]+)$"),
+     "post_grpc"),
     # cluster transactions (reference: http_handler.go:528-533)
     ("POST", re.compile(r"^/transaction/?$"), "post_transaction"),
     ("GET", re.compile(r"^/transaction/([^/]+)$"), "get_transaction"),
@@ -120,8 +124,31 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    #: set by serve(auth=...); None = auth disabled
+    auth = None
+    _auth_ctx: dict = {}
+
+    def _check_auth(self, name: str, match) -> None:
+        """Per-route gating (reference: http_handler.go:497 chkAuthZ).
+        Unlisted routes — including every /internal/* — need admin."""
+        from pilosa_tpu.server.auth import ROUTE_LEVELS
+
+        ctx = self.auth.authenticate(self.headers, self.client_address[0])
+        self._auth_ctx = ctx
+        level, takes_index = ROUTE_LEVELS.get(name, ("admin", False))
+        index = match.group(1) if takes_index and match.groups() else None
+        self.auth.authorize(ctx, level, index)
+
+    def _require_write(self, index) -> None:
+        """Post-parse escalation: a query statement that writes needs
+        write permission even though the route admits readers
+        (reference: the handler checks query write-ness for authz)."""
+        if self.auth is not None:
+            self.auth.authorize(self._auth_ctx, "write", index)
+
     def _dispatch(self, method: str) -> None:
         from pilosa_tpu.obs.metrics import METRIC_HTTP_DURATION, REGISTRY
+        from pilosa_tpu.server.auth import AuthError
 
         for m, pattern, name in _ROUTES:
             if m != method:
@@ -129,9 +156,13 @@ class Handler(BaseHTTPRequestHandler):
             match = pattern.match(self.path.split("?", 1)[0])
             if match:
                 try:
+                    if self.auth is not None:
+                        self._check_auth(name, match)
                     with REGISTRY.timer(METRIC_HTTP_DURATION,
                                         method=method, route=name):
                         getattr(self, name)(*match.groups())
+                except AuthError as e:
+                    self._send(e.code, {"error": str(e)})
                 except KeyError as e:
                     self._send(404, {"error": str(e)})
                 except (ValueError, json.JSONDecodeError) as e:
@@ -164,13 +195,44 @@ class Handler(BaseHTTPRequestHandler):
             q = json.loads(raw or b"{}").get("query", "")
         else:
             q = raw.decode()
+        if self.auth is not None:
+            from pilosa_tpu.pql.executor import has_write_calls
+            from pilosa_tpu.pql.parser import parse
+
+            q = parse(q)  # parsed once; api.query accepts the AST
+            if has_write_calls(q):
+                self._require_write(index)
         self._send(200, self.api.query_json(index, q))
 
     def post_sql(self):
         """SQL query; body is the raw SQL text (reference:
         http_handler.go:536 POST /sql -> :1440 handlePostSQL)."""
         # SQLError subclasses ValueError -> _dispatch maps it to a 400
-        self._send(200, self.api.sql(self._body().decode()).to_json())
+        text = self._body().decode()
+        parsed = None
+        if self.auth is not None:
+            parsed = self._authorize_sql(text)
+        self._send(200, self.api.sql(text, parsed=parsed).to_json())
+
+    def _authorize_sql(self, text: str) -> None:
+        """SQL statements escalate by kind: DDL matches the admin-only
+        HTTP index routes, DML needs write on its table, reads pass at
+        route level (reference: the sql handler applies the same levels
+        as the REST surface)."""
+        from pilosa_tpu.sql import ast as sql_ast
+        from pilosa_tpu.sql.parser import parse_statement
+
+        stmt = parse_statement(text)
+        if isinstance(stmt, (sql_ast.SelectStatement, sql_ast.ShowTables,
+                             sql_ast.ShowColumns, sql_ast.ShowDatabases)):
+            return stmt
+        if isinstance(stmt, (sql_ast.CreateTable, sql_ast.DropTable,
+                             sql_ast.AlterTable)):
+            self.auth.authorize(self._auth_ctx, "admin", None)
+            return stmt
+        table = getattr(stmt, "table", None) or getattr(stmt, "name", None)
+        self._require_write(table)
+        return stmt
 
     def post_index(self, index: str):
         self.api.create_index(index, self._json_body().get("options"))
@@ -345,6 +407,59 @@ class Handler(BaseHTTPRequestHandler):
         if not hasattr(self.api, "query_remote"):
             raise KeyError("not a cluster node")
 
+    def post_grpc(self, method: str):
+        """gRPC method over HTTP/1.1 with standard gRPC message framing
+        (server/grpc.py; grpc-status rides a header since HTTP/1.1 lacks
+        trailers)."""
+        from pilosa_tpu.server.grpc import PilosaServicer, frame, unframe
+
+        body = self._body()
+        messages = unframe(body) if body else [b""]
+        request = messages[0] if messages else b""
+        if self.auth is not None:
+            self._authorize_grpc(method, request)
+        try:
+            responses = PilosaServicer(self.api).call(method, request)
+        except KeyError as e:
+            self._send_grpc(b"", status=12, message=str(e))  # UNIMPLEMENTED
+            return
+        except Exception as e:
+            self._send_grpc(b"", status=13, message=str(e))  # INTERNAL
+            return
+        self._send_grpc(b"".join(frame(m) for m in responses))
+
+    def _authorize_grpc(self, method: str, request: bytes) -> None:
+        """Per-method gRPC authz mirroring the HTTP routes (reference:
+        the same chkAuthZ levels apply to grpc handlers): index CRUD is
+        admin, queries escalate read -> write/admin on their content."""
+        from pilosa_tpu.server import proto as P
+
+        ctx = self._auth_ctx
+        if method in ("CreateIndex", "DeleteIndex"):
+            self.auth.authorize(ctx, "admin", None)
+        elif method in ("QueryPQL", "QueryPQLUnary"):
+            from pilosa_tpu.pql.executor import has_write_calls
+            from pilosa_tpu.pql.parser import parse
+
+            req = P.decode_query_pql_request(request)
+            self.auth.authorize(ctx, "read", req["index"])
+            if has_write_calls(parse(req["pql"])):
+                self.auth.authorize(ctx, "write", req["index"])
+        elif method in ("QuerySQL", "QuerySQLUnary"):
+            req = P.decode_query_sql_request(request)
+            self._authorize_sql(req["sql"])
+
+    def _send_grpc(self, payload: bytes, status: int = 0,
+                   message: str = "") -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/grpc")
+        self.send_header("grpc-status", str(status))
+        if message:
+            self.send_header("grpc-message", message.replace("\n", " "))
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def post_directive(self):
         """DAX assignment push (reference: api_directive.go:21
         ApplyDirective); only compute nodes implement it."""
@@ -400,14 +515,16 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(api: API, host: str = "127.0.0.1", port: int = 10101,
-          background: bool = False, maintenance_interval_s: Optional[float] = None
+          background: bool = False, maintenance_interval_s: Optional[float] = None,
+          auth=None
           ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
     """Start the HTTP server (reference: server.go:618 Open + listener).
     With background=True returns (server, thread) for in-process use —
     the test harness pattern (reference: test/cluster.go). A maintenance
     interval starts the TTL view-removal loop (reference: server.go:902
-    ViewsRemoval ticker)."""
-    handler = type("BoundHandler", (Handler,), {"api": api})
+    ViewsRemoval ticker). ``auth`` (a server.auth.Auth) enables per-route
+    JWT gating (reference: http_handler.go chkAuthZ)."""
+    handler = type("BoundHandler", (Handler,), {"api": api, "auth": auth})
 
     class _Server(ThreadingHTTPServer):
         maintenance_loop = None

@@ -45,9 +45,9 @@ class SQLEngine:
         self.api = api
         self.planner = Planner(api)
 
-    def query(self, sql: str) -> SQLResult:
+    def query(self, sql: str, parsed=None) -> SQLResult:
         t0 = time.monotonic()
-        stmt = parse_statement(sql)
+        stmt = parsed if parsed is not None else parse_statement(sql)
         res = self._dispatch(stmt)
         res.exec_ms = (time.monotonic() - t0) * 1000
         return res
