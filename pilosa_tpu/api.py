@@ -34,6 +34,13 @@ class API:
         # transaction.go cluster transactions)
         self.history = ExecutionRequestsAPI()
         self.transactions = TransactionManager()
+        # auto-ID reservation service, served at /internal/idalloc/*
+        # (reference: idalloc.go + http_handler.go:582-585)
+        import os as _os
+
+        from pilosa_tpu.ingest.idalloc import IDAllocator
+        self.idalloc = IDAllocator(
+            _os.path.join(path, "idalloc.jsonl") if path else None)
         self._sql_engine = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
@@ -92,8 +99,9 @@ class API:
         from pilosa_tpu.pql.executor import has_write_calls
 
         M.REGISTRY.count(M.METRIC_PQL_QUERIES)
-        rec = self.history.begin(index, pql if isinstance(pql, str) else "",
-                                 "pql")
+        text = pql if isinstance(pql, str) else "".join(
+            c.to_pql() for c in getattr(pql, "calls", []))
+        rec = self.history.begin(index, text, "pql")
         span = get_tracer().start_span("executor.Execute", index=index)
         try:
             parsed = parse(pql) if isinstance(pql, str) else pql

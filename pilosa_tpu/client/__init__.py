@@ -1,0 +1,7 @@
+"""Standalone client library (reference: client/ — Client + ORM query
+builder + shard-aware importer)."""
+
+from pilosa_tpu.client.client import Client
+from pilosa_tpu.client.orm import Schema
+
+__all__ = ["Client", "Schema"]

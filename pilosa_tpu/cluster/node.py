@@ -213,6 +213,10 @@ class ClusterNode:
         return self.api.history
 
     @property
+    def idalloc(self):
+        return self.api.idalloc
+
+    @property
     def txf(self):
         """DML group-commit context: local holder's write lock + WAL
         flush. Remote writes commit per-import on their owners — SQL

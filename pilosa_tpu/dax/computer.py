@@ -116,44 +116,15 @@ class Computer:
                     table, shard, exc)
 
     def _export_shard(self, table: str, shard: int) -> Dict[str, np.ndarray]:
-        """The shard's planes as named arrays (the snapshot payload)."""
-        idx = self.api.holder.index(table)
-        out: Dict[str, np.ndarray] = {}
-        for fname, field in idx.fields.items():
-            for view, frags in field.views.items():
-                frag = frags.get(shard)
-                if frag is not None and frag.row_ids:
-                    n = len(frag.row_ids)
-                    out[f"set|{fname}|{view}"] = frag.planes[:n]
-                    out[f"rows|{fname}|{view}"] = np.asarray(
-                        frag.row_ids, dtype=np.int64)
-            bfrag = field.bsi.get(shard)
-            if bfrag is not None:
-                out[f"bsi|{fname}"] = bfrag.planes
-        return out
+        from pilosa_tpu.storage.store import export_shard_arrays
+
+        return export_shard_arrays(self.api.holder.index(table), shard)
 
     def _install_snapshot(self, table: str, shard: int,
                           arrays: Dict[str, np.ndarray]) -> None:
-        idx = self.api.holder.index(table)
-        for key, arr in arrays.items():
-            parts = key.split("|")
-            if parts[0] == "set":
-                _, fname, view = parts
-                frag = idx.field(fname).fragment(shard, view, create=True)
-                rows = arrays[f"rows|{fname}|{view}"]
-                frag.row_ids = [int(r) for r in rows]
-                frag.row_index = {int(r): i for i, r in enumerate(rows)}
-                frag.planes = _grow_rows(
-                    np.ascontiguousarray(arr, dtype=np.uint32), len(rows))
-                frag.version += 1
-                frag.deltas.reset(frag.version)
-            elif parts[0] == "bsi":
-                _, fname = parts
-                bfrag = idx.field(fname).bsi_fragment(shard, create=True)
-                bfrag.planes = np.ascontiguousarray(arr, dtype=np.uint32)
-                bfrag.depth = bfrag.planes.shape[0] - 2
-                bfrag.version += 1
-                bfrag.deltas.reset(bfrag.version)
+        from pilosa_tpu.storage.store import install_shard_arrays
+
+        install_shard_arrays(self.api.holder.index(table), shard, arrays)
 
     def _apply_op(self, table: str, op: dict, shard: int) -> None:
         k = op["k"]
@@ -288,6 +259,10 @@ class Computer:
     @property
     def history(self):
         return self.api.history
+
+    @property
+    def idalloc(self):
+        return self.api.idalloc
 
     def query(self, index: str, pql: str, shards=None):
         # direct (non-wire) queries, e.g. health checks against one node

@@ -105,6 +105,8 @@ class Queryer:
             if inner.name in ("Set", "Clear"):
                 col = inner.arg("_col")
                 if isinstance(col, str):
+                    if not self.holder.index(index).options.keys:
+                        continue  # executor raises cleanly; no state
                     ids = self.executor.translator.index_keys(
                         index, [col], create=True)
                     col = ids.get(col)

@@ -106,9 +106,13 @@ class Permissions:
             if index is not None and index in perms:
                 best = max(best, _LEVELS.get(perms[index], LEVEL_NONE))
             elif index is None:
-                # no specific index (schema-wide reads): any grant counts
+                # No specific index (schema-wide reads / transactions):
+                # any grant counts, but capped below admin — per-index
+                # grants must never confer GLOBAL admin (only the admin
+                # group does; reference: authz IsAdmin is group-based).
                 for lvl in perms.values():
-                    best = max(best, _LEVELS.get(lvl, LEVEL_NONE))
+                    best = max(best, min(_LEVELS.get(lvl, LEVEL_NONE),
+                                         LEVEL_WRITE))
         return best
 
 

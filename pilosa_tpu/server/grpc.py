@@ -38,39 +38,50 @@ def _pql_table(api, index: str, pql: str) -> Tuple[List[Tuple[str, str]],
     results = api.query(index, pql)
     headers: List[Tuple[str, str]] = []
     rows: List[List[Any]] = []
+    seen_headers: List[List[Tuple[str, str]]] = []
+
+    def _set_headers(h):
+        nonlocal headers
+        if headers and h != headers:
+            raise ValueError(
+                "QueryPQL over gRPC supports one result shape per "
+                "request; split calls with different shapes")
+        headers = h
+
     for r in results:
         if isinstance(r, R.RowResult):
             if r.keys is not None:
-                headers = [("_id", "STRING")]
+                _set_headers([("_id", "STRING")])
                 rows += [[k] for k in r.keys]
             else:
-                headers = [("_id", "ID")]
+                _set_headers([("_id", "ID")])
                 rows += [[c] for c in r.columns]
         elif isinstance(r, R.PairsField):
             keyed = any(p.key is not None for p in r.pairs)
-            headers = [(r.field, "STRING" if keyed else "ID"),
-                       ("count", "INT")]
+            _set_headers([(r.field, "STRING" if keyed else "ID"),
+                          ("count", "INT")])
             rows += [[p.key if keyed else p.id, p.count] for p in r.pairs]
         elif isinstance(r, R.ValCount):
-            headers = [("value", "INT"), ("count", "INT")]
+            _set_headers([("value", "INT"), ("count", "INT")])
             rows += [[r.val, r.count]]
         elif isinstance(r, (int, bool)):
-            headers = [("result", "INT" if isinstance(r, int)
-                        and not isinstance(r, bool) else "BOOL")]
+            _set_headers([("result", "INT" if isinstance(r, int)
+                           and not isinstance(r, bool) else "BOOL")])
             rows += [[r]]
         elif isinstance(r, list):  # GroupBy / Rows / Distinct
             if r and isinstance(r[0], R.GroupCount):
                 gfields = [fr.field for fr in r[0].group]
-                headers = [(f, "ID") for f in gfields] + [("count", "INT")]
+                _set_headers([(f, "ID") for f in gfields]
+                             + [("count", "INT")])
                 for gc in r:
                     rows.append([fr.row_key if fr.row_key is not None
                                  else fr.row_id for fr in gc.group]
                                 + [gc.count])
             else:
-                headers = [("value", "INT")]
+                _set_headers([("value", "INT")])
                 rows += [[v] for v in r]
         else:
-            headers = [("result", "STRING")]
+            _set_headers([("result", "STRING")])
             rows += [[str(r)]]
     return headers, rows
 
@@ -84,11 +95,11 @@ class PilosaServicer:
 
     # -- queries -----------------------------------------------------------
 
-    def query_sql_rows(self, sql: str) -> Iterator[bytes]:
+    def query_sql_rows(self, sql: str, parsed=None) -> Iterator[bytes]:
         """QuerySQL: one RowResponse per row, headers on the first
         (reference: grpc.go:160 QuerySQL streaming contract)."""
         t0 = time.monotonic_ns()
-        res = self.api.sql(sql)
+        res = self.api.sql(sql, parsed=parsed)
         headers = _sql_headers(res.schema)
         types = [t for _, t in headers]
         first = True
@@ -101,9 +112,9 @@ class PilosaServicer:
             yield proto.encode_row_response(
                 headers, [], types, duration_ns=time.monotonic_ns() - t0)
 
-    def query_sql_unary(self, sql: str) -> bytes:
+    def query_sql_unary(self, sql: str, parsed=None) -> bytes:
         t0 = time.monotonic_ns()
-        res = self.api.sql(sql)
+        res = self.api.sql(sql, parsed=parsed)
         return proto.encode_table_response(
             _sql_headers(res.schema), res.data, time.monotonic_ns() - t0)
 
@@ -149,15 +160,17 @@ class PilosaServicer:
 
     # -- framed dispatch (shared by HTTP fallback and tests) ---------------
 
-    def call(self, method: str, request: bytes) -> List[bytes]:
+    def call(self, method: str, request: bytes,
+             parsed_sql=None) -> List[bytes]:
         """Execute one method on a decoded request; returns the response
-        message(s) (one per stream element)."""
+        message(s) (one per stream element). ``parsed_sql`` reuses a
+        statement the authed HTTP handler already parsed."""
         if method == "QuerySQL":
             req = proto.decode_query_sql_request(request)
-            return list(self.query_sql_rows(req["sql"]))
+            return list(self.query_sql_rows(req["sql"], parsed=parsed_sql))
         if method == "QuerySQLUnary":
             req = proto.decode_query_sql_request(request)
-            return [self.query_sql_unary(req["sql"])]
+            return [self.query_sql_unary(req["sql"], parsed=parsed_sql)]
         if method == "QueryPQL":
             req = proto.decode_query_pql_request(request)
             return list(self.query_pql_rows(req["index"], req["pql"]))

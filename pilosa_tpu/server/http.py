@@ -63,6 +63,18 @@ _ROUTES = [
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
     ("GET", re.compile(r"^/info$"), "get_info"),
+    # per-shard snapshot stream (reference: api.go:1265 IndexShardSnapshot
+    # via /internal/index/{i}/shard/{s}/snapshot)
+    ("GET", re.compile(r"^/internal/index/([^/]+)/shard/(\d+)/snapshot$"),
+     "get_shard_snapshot"),
+    # auto-ID allocation (reference: http_handler.go:582-585)
+    ("POST", re.compile(r"^/internal/idalloc/reserve$"),
+     "post_idalloc_reserve"),
+    ("POST", re.compile(r"^/internal/idalloc/commit$"),
+     "post_idalloc_commit"),
+    # profiling (reference: /debug/pprof http_handler.go:493; per-query
+    # CPU profiles :1301 DoPerQueryProfiling — ours via ?profile=true)
+    ("GET", re.compile(r"^/debug/pprof$"), "get_pprof"),
     # backup/restore/chksum (reference: ctl/backup.go internal endpoints)
     ("GET", re.compile(r"^/internal/backup\.tar$"), "get_backup_tar"),
     ("POST", re.compile(r"^/internal/restore$"), "post_restore"),
@@ -202,6 +214,25 @@ class Handler(BaseHTTPRequestHandler):
             q = parse(q)  # parsed once; api.query accepts the AST
             if has_write_calls(q):
                 self._require_write(index)
+        if "profile=true" in (self.path.split("?", 1) + [""])[1]:
+            # per-query CPU profile (reference: http_handler.go:1301
+            # DoPerQueryProfiling); top functions ride in the response
+            import cProfile
+            import io as _io
+            import pstats
+
+            prof = cProfile.Profile()
+            prof.enable()
+            try:
+                out = self.api.query_json(index, q)
+            finally:
+                prof.disable()
+            s = _io.StringIO()
+            pstats.Stats(prof, stream=s).sort_stats("cumulative") \
+                .print_stats(25)
+            out["profile"] = s.getvalue().splitlines()
+            self._send(200, out)
+            return
         self._send(200, self.api.query_json(index, q))
 
     def post_sql(self):
@@ -214,21 +245,34 @@ class Handler(BaseHTTPRequestHandler):
             parsed = self._authorize_sql(text)
         self._send(200, self.api.sql(text, parsed=parsed).to_json())
 
-    def _authorize_sql(self, text: str) -> None:
-        """SQL statements escalate by kind: DDL matches the admin-only
-        HTTP index routes, DML needs write on its table, reads pass at
-        route level (reference: the sql handler applies the same levels
-        as the REST surface)."""
+    def _authorize_sql(self, text: str):
+        """SQL statements escalate by kind, checked against the SPECIFIC
+        tables they touch (the same levels as the REST surface): SELECT
+        needs read on every table it reads (incl. join sides), DDL needs
+        admin on its table, DML write on its table."""
         from pilosa_tpu.sql import ast as sql_ast
         from pilosa_tpu.sql.parser import parse_statement
 
         stmt = parse_statement(text)
-        if isinstance(stmt, (sql_ast.SelectStatement, sql_ast.ShowTables,
-                             sql_ast.ShowColumns, sql_ast.ShowDatabases)):
+        ctx = self._auth_ctx
+        if isinstance(stmt, sql_ast.SelectStatement):
+            from pilosa_tpu.sql.engine import _SYSTEM_TABLES
+
+            tables = [stmt.table] + [j.table for j in stmt.joins]
+            for t in tables:
+                if t is not None and t not in _SYSTEM_TABLES:
+                    self.auth.authorize(ctx, "read", t)
+            return stmt
+        if isinstance(stmt, sql_ast.ShowColumns):
+            self.auth.authorize(ctx, "read", stmt.table)
+            return stmt
+        if isinstance(stmt, (sql_ast.ShowTables, sql_ast.ShowDatabases)):
             return stmt
         if isinstance(stmt, (sql_ast.CreateTable, sql_ast.DropTable,
                              sql_ast.AlterTable)):
-            self.auth.authorize(self._auth_ctx, "admin", None)
+            # per-table admin grant or the global admin group (mirrors
+            # DELETE /index/{i} which checks admin on i)
+            self.auth.authorize(ctx, "admin", stmt.name)
             return stmt
         table = getattr(stmt, "table", None) or getattr(stmt, "name", None)
         self._require_write(table)
@@ -416,10 +460,12 @@ class Handler(BaseHTTPRequestHandler):
         body = self._body()
         messages = unframe(body) if body else [b""]
         request = messages[0] if messages else b""
+        parsed_sql = None
         if self.auth is not None:
-            self._authorize_grpc(method, request)
+            parsed_sql = self._authorize_grpc(method, request)
         try:
-            responses = PilosaServicer(self.api).call(method, request)
+            responses = PilosaServicer(self.api).call(
+                method, request, parsed_sql=parsed_sql)
         except KeyError as e:
             self._send_grpc(b"", status=12, message=str(e))  # UNIMPLEMENTED
             return
@@ -447,7 +493,8 @@ class Handler(BaseHTTPRequestHandler):
                 self.auth.authorize(ctx, "write", req["index"])
         elif method in ("QuerySQL", "QuerySQLUnary"):
             req = P.decode_query_sql_request(request)
-            self._authorize_sql(req["sql"])
+            return self._authorize_sql(req["sql"])
+        return None
 
     def _send_grpc(self, payload: bytes, status: int = 0,
                    message: str = "") -> None:
@@ -459,6 +506,53 @@ class Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+    def get_shard_snapshot(self, index: str, shard: str):
+        """Stream one shard's planes as npz (reference: api.go:1265 —
+        backup reads per-shard snapshots concurrently with writes; our
+        export walks versioned host planes, so it is consistent per
+        fragment)."""
+        import io as _io
+
+        import numpy as _np
+
+        from pilosa_tpu.storage.store import export_shard_arrays
+
+        idx = self.api.holder.index(index)
+        arrays = export_shard_arrays(idx, int(shard))
+        buf = _io.BytesIO()
+        _np.savez_compressed(buf, **arrays)
+        data = buf.getvalue()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def post_idalloc_reserve(self):
+        b = self._json_body()
+        rng = self.api.idalloc.reserve(
+            self._require(b, "session"), int(self._require(b, "count")),
+            int(b.get("offset", 0)))
+        self._send(200, {"base": rng.base, "count": rng.count})
+
+    def post_idalloc_commit(self):
+        b = self._json_body()
+        self.api.idalloc.commit(self._require(b, "session"),
+                                b.get("count"))
+        self._send(200, {"success": True})
+
+    def get_pprof(self):
+        """Thread stack dump (the Python analog of goroutine profiles at
+        /debug/pprof; per-query CPU profiling rides ?profile=true on
+        query routes)."""
+        import sys
+        import traceback
+
+        stacks = {}
+        for tid, frame in sys._current_frames().items():
+            stacks[str(tid)] = traceback.format_stack(frame)
+        self._send(200, {"threads": stacks})
 
     def post_directive(self):
         """DAX assignment push (reference: api_directive.go:21

@@ -159,9 +159,9 @@ def encode_column_response(value: Any, datatype: str) -> bytes:
     if isinstance(value, float):
         return _tag(8, _I64) + struct.pack("<d", value)
     if isinstance(value, str):
-        if datatype == "TIMESTAMP":
-            return _str_field(10, value)
-        return _str_field(1, value)
+        # oneof members must encode even when empty ('' != NULL)
+        field = 10 if datatype == "TIMESTAMP" else 1
+        return _len_field(field, value.encode())
     if isinstance(value, (list, tuple)):
         if all(isinstance(x, int) for x in value):
             inner = b"".join(_tag(1, _VARINT) + _encode_varint(x)

@@ -175,3 +175,49 @@ def _atomic_savez(path: str, **arrays) -> None:
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
     os.replace(tmp, path)
+
+
+def export_shard_arrays(idx, shard: int) -> dict:
+    """One shard's planes as named arrays (the shard-snapshot payload;
+    reference: api.go:1265 IndexShardSnapshot streams the RBF pages —
+    here the dense planes). Keys: set|field|view + rows|field|view for
+    bitmap fragments, bsi|field for BSI stacks."""
+    out = {}
+    for fname, field in idx.fields.items():
+        for view, frags in field.views.items():
+            frag = frags.get(shard)
+            if frag is not None and frag.row_ids:
+                n = len(frag.row_ids)
+                out[f"set|{fname}|{view}"] = frag.planes[:n]
+                out[f"rows|{fname}|{view}"] = np.asarray(
+                    frag.row_ids, dtype=np.int64)
+        bfrag = field.bsi.get(shard)
+        if bfrag is not None:
+            out[f"bsi|{fname}"] = bfrag.planes
+    return out
+
+
+def install_shard_arrays(idx, shard: int, arrays: dict) -> None:
+    """Inverse of export_shard_arrays: plane-level install (restore /
+    DAX snapshot resume)."""
+    from pilosa_tpu.core.fragment import _grow_rows
+
+    for key, arr in arrays.items():
+        parts = key.split("|")
+        if parts[0] == "set":
+            _, fname, view = parts
+            frag = idx.field(fname).fragment(shard, view, create=True)
+            rows = arrays[f"rows|{fname}|{view}"]
+            frag.row_ids = [int(r) for r in rows]
+            frag.row_index = {int(r): i for i, r in enumerate(rows)}
+            frag.planes = _grow_rows(
+                np.ascontiguousarray(arr, dtype=np.uint32), len(rows))
+            frag.version += 1
+            frag.deltas.reset(frag.version)
+        elif parts[0] == "bsi":
+            _, fname = parts
+            bfrag = idx.field(fname).bsi_fragment(shard, create=True)
+            bfrag.planes = np.ascontiguousarray(arr, dtype=np.uint32)
+            bfrag.depth = bfrag.planes.shape[0] - 2
+            bfrag.version += 1
+            bfrag.deltas.reset(bfrag.version)

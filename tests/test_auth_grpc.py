@@ -341,3 +341,48 @@ class TestGRPCAuthz:
     def test_sql_ddl_needs_admin(self, base):
         msg = proto._str_field(1, "drop table t")
         assert self._grpc(base, "QuerySQLUnary", msg, WRITE_G) == 403
+
+
+class TestAuthPrecision:
+    """Review fixes: per-table SQL SELECT authz; per-index admin grants
+    never confer global admin."""
+
+    @pytest.fixture(scope="class")
+    def base(self):
+        api = API()
+        for name in ("t", "secret"):
+            api.create_index(name)
+            api.create_field(name, "f", {"type": "set"})
+        perms = Permissions(user_groups={
+            READ_G: {"t": "read"},
+            "idx-admins": {"t": "admin"},
+        }, admin=ADMIN_G)
+        srv, _ = serve(api, port=0, background=True,
+                       auth=Auth(SECRET, perms))
+        yield f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        srv.shutdown()
+        srv.server_close()
+
+    def test_sql_select_checks_each_table(self, base):
+        tok = issue_token(SECRET, [READ_G])
+        code, _ = _req(base, "POST", "/sql", b"select count(*) from t", tok)
+        assert code == 200
+        code, _ = _req(base, "POST", "/sql",
+                       b"select count(*) from secret", tok)
+        assert code == 403
+        code, _ = _req(base, "POST", "/sql",
+                       b"select count(*) from t inner join secret "
+                       b"on t._id = secret._id", tok)
+        assert code == 403
+
+    def test_per_index_admin_not_global(self, base):
+        tok = issue_token(SECRET, ["idx-admins"])
+        # admin on 't' allows dropping t...
+        code, _ = _req(base, "POST", "/sql", b"drop table t", tok)
+        assert code == 200
+        # ...but NOT dropping (or reading) other tables
+        code, _ = _req(base, "POST", "/sql", b"drop table secret", tok)
+        assert code == 403
+        code, _ = _req(base, "POST", "/sql",
+                       b"select count(*) from secret", tok)
+        assert code == 403

@@ -1,0 +1,222 @@
+"""Client library + extended IDK sources.
+
+Reference: client/orm.go serialization semantics, client/client.go +
+importer.go round trips, idk/sql/, idk/kinesis/, Avro registry decoding.
+The client round-trip test is the VERDICT r3 #9 done-criterion."""
+
+import json
+import sqlite3
+import struct
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.client import Client, Schema
+from pilosa_tpu.core.schema import FieldOptions, FieldType
+from pilosa_tpu.ingest.ingest import Ingester
+from pilosa_tpu.ingest.sources_ext import (
+    AvroSource, KinesisSource, SQLSource, avro_decode,
+)
+from pilosa_tpu.server.http import serve
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class TestORM:
+    def test_serialization(self):
+        s = Schema()
+        idx = s.index("i")
+        f = idx.field("f")
+        g = idx.field("g")
+        assert f.row(5).serialize() == "Row(f=5)"
+        assert f.row("k").serialize() == "Row(f='k')"
+        assert (f.row(1) & g.row(2)).serialize() == \
+            "Intersect(Row(f=1), Row(g=2))"
+        assert (f.row(1) | g.row(2)).serialize() == \
+            "Union(Row(f=1), Row(g=2))"
+        assert (f.row(1) - g.row(2)).serialize() == \
+            "Difference(Row(f=1), Row(g=2))"
+        assert (~f.row(1)).serialize() == "Not(Row(f=1))"
+        assert idx.count(f.row(1)).serialize() == "Count(Row(f=1))"
+        assert f.topn(5).serialize() == "TopN(f, n=5)"
+        n = idx.field("n")
+        assert n.gt(3).serialize() == "Row(n > 3)"
+        assert n.between(2, 8).serialize() == "Row(2 <= n <= 8)"
+        assert n.sum(f.row(1)).serialize() == "Sum(Row(f=1), field=n)"
+        assert f.set(3, 10).serialize() == "Set(10, f=3)"
+        assert idx.group_by(f.rows(), limit=4).serialize() == \
+            "GroupBy(Rows(f), limit=4)"
+        assert idx.batch_query(f.set(1, 2), idx.count(f.row(1))
+                               ).serialize() == "Set(2, f=1)Count(Row(f=1))"
+
+
+@pytest.fixture()
+def served():
+    api = API()
+    srv, _ = serve(api, port=0, background=True)
+    yield f"http://{srv.server_address[0]}:{srv.server_address[1]}", api
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestClientRoundTrip:
+    def test_schema_sync_import_query(self, served):
+        base, api = served
+        c = Client(base)
+        schema = Schema()
+        idx = schema.index("ci")
+        f = idx.field("f", type="set")
+        n = idx.field("n", type="int")
+        c.sync_schema(schema)
+        assert "ci" in api.holder.indexes
+        # shard-aware roaring import across two shards
+        bits = [(1, 5), (1, SHARD_WIDTH + 9), (2, 7)]
+        c.import_bits("ci", "f", bits)
+        assert c.query(idx.count(f.row(1))) == [2]
+        assert c.query(f.row(2))[0]["columns"] == [7]
+        r = c.query(f.row(1))
+        assert r[0]["columns"] == [5, SHARD_WIDTH + 9]
+        # BSI values + ORM aggregate
+        c.import_values("ci", "n", [(5, 10), (7, -3)])
+        assert c.query(n.sum())[0]["value"] == 7
+        # ORM write + sql
+        c.query(f.set(9, 11))
+        assert c.query(idx.count(f.row(9))) == [1]
+        out = c.sql("select count(*) from ci")
+        assert out["data"] == [[4]]
+        # schema() reads back what we created
+        got = c.schema()
+        assert {i.name for i in got.indexes()} >= {"ci"}
+
+    def test_json_import_path_and_keyed(self, served):
+        base, api = served
+        c = Client(base)
+        c.create_index("kj", keys=True)
+        c._json("POST", "/index/kj/field/tag",
+                {"options": {"type": "set", "keys": True}})
+        c.import_keyed_bits("kj", "tag", [("red", "a"), ("red", "b"),
+                                          ("blue", "a")])
+        out = c.query("Count(Row(tag='red'))", index="kj")
+        assert out == [2]
+        # non-roaring JSON path
+        c.create_index("pj")
+        c._json("POST", "/index/pj/field/f", {"options": {"type": "set"}})
+        c.import_bits("pj", "f", [(1, 2), (1, 3)], roaring=False)
+        assert c.query("Count(Row(f=1))", index="pj") == [2]
+
+
+class TestSQLSource:
+    def test_sqlite_ingest(self):
+        conn = sqlite3.connect(":memory:")
+        conn.execute("create table people "
+                     "(id integer, city text, age integer)")
+        conn.executemany("insert into people values (?, ?, ?)",
+                         [(1, "paris", 30), (2, "tokyo", 41),
+                          (3, "paris", 25)])
+        api = API()
+        src = SQLSource(conn, "select id, city, age from people",
+                        types={"age": "int"})
+        n = Ingester(api, "people", src).run()
+        assert n == 3
+        assert api.query("people", "Count(Row(city='paris'))")[0] == 2
+        assert api.query("people", "Sum(field=age)")[0].val == 96
+
+
+class _StubKinesis:
+    """boto3-shaped stub (reference tests use localstack; we inject)."""
+
+    def __init__(self, records):
+        self._records = [json.dumps(r).encode() for r in records]
+
+    def describe_stream(self, StreamName):
+        return {"StreamDescription": {"Shards": [{"ShardId": "s-0"}]}}
+
+    def get_shard_iterator(self, **kw):
+        return {"ShardIterator": "it-0"}
+
+    def get_records(self, ShardIterator):
+        recs, self._records = self._records, []
+        return {"Records": [{"Data": d} for d in recs],
+                "NextShardIterator": None}
+
+
+class TestKinesisSource:
+    def test_stub_stream_ingest(self):
+        src = KinesisSource(
+            "events", client=_StubKinesis([
+                {"id": 1, "kind": "click"},
+                {"id": 2, "kind": "view"},
+                {"id": 3, "kind": "click"},
+            ]),
+            schema=[("kind", FieldOptions(type=FieldType.MUTEX, keys=True))])
+        api = API()
+        assert Ingester(api, "ev", src).run() == 3
+        assert api.query("ev", "Count(Row(kind='click'))")[0] == 2
+
+    def test_missing_boto3_is_loud(self):
+        with pytest.raises(RuntimeError):
+            KinesisSource("events")
+
+
+def _avro_long(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_string(s: str) -> bytes:
+    raw = s.encode()
+    return _avro_long(len(raw)) + raw
+
+
+AVRO_SCHEMA = {
+    "type": "record", "name": "ev",
+    "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "city", "type": "string"},
+        {"name": "score", "type": "double"},
+        {"name": "maybe", "type": ["null", "long"]},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+    ],
+}
+
+
+def _avro_record(rid, city, score, maybe, tags):
+    out = _avro_long(rid) + _avro_string(city) + struct.pack("<d", score)
+    if maybe is None:
+        out += _avro_long(0)
+    else:
+        out += _avro_long(1) + _avro_long(maybe)
+    if tags:
+        out += _avro_long(len(tags))
+        for t in tags:
+            out += _avro_string(t)
+    out += _avro_long(0)
+    return b"\x00" + (7).to_bytes(4, "big") + out
+
+
+class TestAvroSource:
+    def test_decode(self):
+        payload = _avro_record(5, "oslo", 1.5, 9, ["a", "b"])
+        rec = avro_decode(AVRO_SCHEMA, payload[5:])
+        assert rec == {"id": 5, "city": "oslo", "score": 1.5,
+                       "maybe": 9, "tags": ["a", "b"]}
+
+    def test_registry_ingest(self):
+        payloads = [
+            _avro_record(1, "oslo", 2.5, None, ["x"]),
+            _avro_record(2, "kyiv", 0.5, 4, ["x", "y"]),
+        ]
+        src = AvroSource(payloads, registry={7: AVRO_SCHEMA})
+        schema_fields = dict((n, o.type) for n, o in src.schema())
+        assert schema_fields["tags"] == FieldType.SET
+        api = API()
+        assert Ingester(api, "av", src).run() == 2
+        assert api.query("av", "Count(Row(city='oslo'))")[0] == 1
+        assert api.query("av", "Count(Row(tags='x'))")[0] == 2

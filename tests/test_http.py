@@ -211,3 +211,90 @@ def test_sql_endpoint(server):
     except urllib.error.HTTPError as e:
         code = e.code
     assert code == 400
+
+
+class TestSurfaceCompletion:
+    """VERDICT r3 #10: shard-snapshot endpoint, /internal/idalloc/*,
+    pprof + per-query profiling."""
+
+    @pytest.fixture()
+    def srv(self):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        api.create_index("t")
+        api.create_field("t", "f", {"type": "set"})
+        api.create_field("t", "n", {"type": "int"})
+        api.query("t", "Set(1, f=2)Set(3, f=2)")
+        api.import_values("t", "n", cols=[1, 3], values=[7, -4])
+        s, _ = serve(api, port=0, background=True)
+        yield f"http://{s.server_address[0]}:{s.server_address[1]}", api
+        s.shutdown()
+        s.server_close()
+
+    def test_shard_snapshot_round_trip(self, srv):
+        import io
+        import urllib.request
+
+        import numpy as np
+
+        from pilosa_tpu.api import API
+        from pilosa_tpu.storage.store import install_shard_arrays
+
+        base, api = srv
+        with urllib.request.urlopen(
+                base + "/internal/index/t/shard/0/snapshot") as r:
+            raw = r.read()
+        with np.load(io.BytesIO(raw)) as z:
+            arrays = {k: z[k] for k in z.files}
+        fresh = API()
+        fresh.create_index("t")
+        fresh.create_field("t", "f", {"type": "set"})
+        fresh.create_field("t", "n", {"type": "int"})
+        install_shard_arrays(fresh.holder.index("t"), 0, arrays)
+        assert fresh.query("t", "Row(f=2)")[0].columns == [1, 3]
+        assert fresh.query("t", "Sum(field=n)")[0].val == 3
+
+    def test_idalloc_over_http(self, srv):
+        import json
+        import urllib.request
+
+        base, _ = srv
+
+        def post(path, body):
+            req = urllib.request.Request(base + path,
+                                         data=json.dumps(body).encode(),
+                                         method="POST")
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        out = post("/internal/idalloc/reserve",
+                   {"session": "s1", "count": 10})
+        assert out["count"] == 10
+        # replay of the same (session, offset) returns the same range
+        out2 = post("/internal/idalloc/reserve",
+                    {"session": "s1", "count": 10})
+        assert out2["base"] == out["base"]
+        post("/internal/idalloc/commit", {"session": "s1", "count": 4})
+        out3 = post("/internal/idalloc/reserve",
+                    {"session": "s2", "count": 5})
+        assert out3["base"] == out["base"] + 4  # unused tail returned
+
+    def test_pprof_and_query_profile(self, srv):
+        import json
+        import urllib.request
+
+        base, _ = srv
+        with urllib.request.urlopen(base + "/debug/pprof") as r:
+            stacks = json.loads(r.read())["threads"]
+        assert stacks and any("http" in "".join(v).lower()
+                              for v in stacks.values())
+        req = urllib.request.Request(
+            base + "/index/t/query?profile=true",
+            data=b"Count(Row(f=2))", method="POST")
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["results"] == [2]
+        assert any("cumulative" in line for line in out["profile"])
