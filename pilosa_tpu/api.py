@@ -9,6 +9,7 @@ ImportRoaring, :1647 ImportRoaringShard).
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -42,9 +43,17 @@ class API:
         self.idalloc = IDAllocator(
             _os.path.join(path, "idalloc.jsonl") if path else None)
         self._sql_engine = None
+        # optional structured query log (reference: server.go:792);
+        # set via api.set_query_logger / config query_log_path
+        self.query_logger = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
             self.holder.recover()
+
+    def set_query_logger(self, path: str) -> None:
+        from pilosa_tpu.obs.logger import QueryLogger
+
+        self.query_logger = QueryLogger(path)
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -103,6 +112,7 @@ class API:
             c.to_pql() for c in getattr(pql, "calls", []))
         rec = self.history.begin(index, text, "pql")
         span = get_tracer().start_span("executor.Execute", index=index)
+        t0 = _time.monotonic()
         try:
             parsed = parse(pql) if isinstance(pql, str) else pql
             # Writes hold the holder write lock for the request and
@@ -117,9 +127,15 @@ class API:
             with ctx:
                 out = self.executor.execute(index, parsed, shards=shards)
             self.history.end(rec)
+            if self.query_logger is not None:
+                self.query_logger.log("pql", index, text,
+                                      _time.monotonic() - t0)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
+            if self.query_logger is not None:
+                self.query_logger.log("pql", index, text,
+                                      _time.monotonic() - t0, error=str(e))
             raise
         finally:
             span.finish()
@@ -137,12 +153,19 @@ class API:
             eng = self._sql_engine = SQLEngine(self)
         M.REGISTRY.count(M.METRIC_SQL_QUERIES)
         rec = self.history.begin("", query, "sql")
+        t0 = _time.monotonic()
         try:
             out = eng.query(query, parsed=parsed)
             self.history.end(rec)
+            if self.query_logger is not None:
+                self.query_logger.log("sql", "", query,
+                                      _time.monotonic() - t0)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
+            if self.query_logger is not None:
+                self.query_logger.log("sql", "", query,
+                                      _time.monotonic() - t0, error=str(e))
             raise
 
     def query_json(self, index: str, pql: str) -> dict:

@@ -15,7 +15,9 @@ def _fmt(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, str):
-        return "'" + v.replace("'", "\\'") + "'"
+        # backslashes BEFORE quotes, or a trailing backslash escapes the
+        # closing quote (parse failure at best, PQL injection at worst)
+        return "'" + v.replace("\\", "\\\\").replace("'", "\\'") + "'"
     return str(v)
 
 

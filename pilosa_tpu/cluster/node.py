@@ -217,6 +217,10 @@ class ClusterNode:
         return self.api.idalloc
 
     @property
+    def query_logger(self):
+        return self.api.query_logger
+
+    @property
     def txf(self):
         """DML group-commit context: local holder's write lock + WAL
         flush. Remote writes commit per-import on their owners — SQL

@@ -39,6 +39,9 @@ class Config:
     auth_allowed_networks: List[str] = dataclasses.field(default_factory=list)
     # observability
     tracing_enable: bool = False
+    log_level: str = "info"
+    log_path: str = ""
+    query_log_path: str = ""  # reference: server.go:792 query logger
     # dataframe (reference: --dataframe.enable; on by default here)
     dataframe_enable: bool = True
 

@@ -36,8 +36,13 @@ def cmd_server(args) -> int:
     from pilosa_tpu.api import API
     from pilosa_tpu.server.http import serve
 
+    from pilosa_tpu.obs.logger import configure as configure_logging
+
+    configure_logging(cfg.log_level, cfg.log_path or None)
     api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync)
     api.holder.checkpoint_bytes = cfg.checkpoint_bytes
+    if cfg.query_log_path:
+        api.set_query_logger(cfg.query_log_path)
     auth = None
     if cfg.auth_enable:
         # the formerly-dead auth config now gates every route
@@ -147,6 +152,83 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_datagen(args) -> int:
+    """Generate a synthetic scenario and ingest it (reference:
+    idk/datagen/datagen.go main driver). In-process without --host
+    (smoke tests); with --host, schema + batched imports drive a remote
+    server through the client library."""
+    from pilosa_tpu.ingest.datagen import scenario
+    from pilosa_tpu.core.schema import FieldType
+
+    src = scenario(args.scenario, rows=args.rows, seed=args.seed)
+    if not args.host:
+        from pilosa_tpu.api import API
+        from pilosa_tpu.ingest.ingest import Ingester
+
+        n = Ingester(API(), args.index, src).run()
+        print(f"datagen: ingested {n} {args.scenario!r} records "
+              f"in-process", file=sys.stderr)
+        return 0
+    from pilosa_tpu.client import Client
+
+    c = Client(args.host)
+    c.create_index(args.index)
+    opts_by_field = {}
+    for fname, fo in src.schema():
+        d = {"type": fo.type.value, "keys": fo.keys}
+        if fo.min is not None:
+            d["min"] = fo.min
+        if fo.max is not None:
+            d["max"] = fo.max
+        if fo.scale:
+            d["scale"] = fo.scale
+        c._json("POST", f"/index/{args.index}/field/{fname}",
+                {"options": d})
+        opts_by_field[fname] = fo
+    n = 0
+    batch_bits = {}
+    batch_vals = {}
+
+    def flush():
+        for fname, pairs in batch_bits.items():
+            fo = opts_by_field[fname]
+            if fo.keys:
+                c._json("POST", f"/index/{args.index}/import",
+                        {"field": fname,
+                         "rowKeys": [str(r) for r, _ in pairs],
+                         "cols": [col for _, col in pairs]})
+            else:
+                c.import_bits(args.index, fname, pairs)
+        for fname, pairs in batch_vals.items():
+            c.import_values(args.index, fname, pairs)
+        batch_bits.clear()
+        batch_vals.clear()
+
+    for rec in src.records():
+        col = int(rec[src.id_column()])
+        for fname, v in rec.items():
+            if fname == src.id_column() or v is None:
+                continue
+            fo = opts_by_field[fname]
+            if fo.type.is_bsi:
+                sv = int(round(v * 10 ** fo.scale)) \
+                    if fo.type == FieldType.DECIMAL else int(v)
+                batch_vals.setdefault(fname, []).append((col, sv))
+            elif fo.type == FieldType.BOOL:
+                batch_bits.setdefault(fname, []).append(
+                    (1 if v else 0, col))
+            else:
+                for item in (v if isinstance(v, list) else [v]):
+                    batch_bits.setdefault(fname, []).append((item, col))
+        n += 1
+        if n % 10_000 == 0:
+            flush()
+    flush()
+    print(f"datagen: ingested {n} {args.scenario!r} records into "
+          f"{args.index!r} at {args.host}", file=sys.stderr)
+    return 0
+
+
 def cmd_fbsql(args) -> int:
     from pilosa_tpu.ctl.fbsql import Shell
 
@@ -199,6 +281,17 @@ def build_parser() -> argparse.ArgumentParser:
     f = sub.add_parser("fbsql", help="interactive SQL shell")
     f.add_argument("--host", default="http://127.0.0.1:10101")
     f.set_defaults(fn=cmd_fbsql)
+
+    d = sub.add_parser("datagen",
+                       help="generate + ingest a synthetic scenario")
+    d.add_argument("--scenario", required=True)
+    d.add_argument("--rows", type=int, default=1000)
+    d.add_argument("--seed", type=int, default=1)
+    d.add_argument("--index", required=True)
+    d.add_argument("--host", default=None,
+                   help="target server; omit for an in-process run "
+                        "(smoke tests)")
+    d.set_defaults(fn=cmd_datagen)
     return p
 
 

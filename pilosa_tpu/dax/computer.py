@@ -264,6 +264,10 @@ class Computer:
     def idalloc(self):
         return self.api.idalloc
 
+    @property
+    def query_logger(self):
+        return self.api.query_logger
+
     def query(self, index: str, pql: str, shards=None):
         # direct (non-wire) queries, e.g. health checks against one node
         return self.api.query(index, pql, shards=shards)

@@ -38,8 +38,6 @@ def _pql_table(api, index: str, pql: str) -> Tuple[List[Tuple[str, str]],
     results = api.query(index, pql)
     headers: List[Tuple[str, str]] = []
     rows: List[List[Any]] = []
-    seen_headers: List[List[Tuple[str, str]]] = []
-
     def _set_headers(h):
         nonlocal headers
         if headers and h != headers:

@@ -214,7 +214,10 @@ class Handler(BaseHTTPRequestHandler):
             q = parse(q)  # parsed once; api.query accepts the AST
             if has_write_calls(q):
                 self._require_write(index)
-        if "profile=true" in (self.path.split("?", 1) + [""])[1]:
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(self.path).query)
+        if qs.get("profile", [""])[-1].lower() == "true":
             # per-query CPU profile (reference: http_handler.go:1301
             # DoPerQueryProfiling); top functions ride in the response
             import cProfile
@@ -269,7 +272,8 @@ class Handler(BaseHTTPRequestHandler):
         if isinstance(stmt, (sql_ast.ShowTables, sql_ast.ShowDatabases)):
             return stmt
         if isinstance(stmt, (sql_ast.CreateTable, sql_ast.DropTable,
-                             sql_ast.AlterTable)):
+                             sql_ast.AlterTable, sql_ast.CreateView,
+                             sql_ast.DropView)):
             # per-table admin grant or the global admin group (mirrors
             # DELETE /index/{i} which checks admin on i)
             self.auth.authorize(ctx, "admin", stmt.name)

@@ -167,7 +167,7 @@ def encode_column_response(value: Any, datatype: str) -> bytes:
             inner = b"".join(_tag(1, _VARINT) + _encode_varint(x)
                              for x in value)
             return _len_field(6, inner)
-        inner = b"".join(_str_field(1, str(x)) for x in value)
+        inner = b"".join(_len_field(1, str(x).encode()) for x in value)
         return _len_field(7, inner)
     if isinstance(value, bytes):
         return _len_field(5, value)

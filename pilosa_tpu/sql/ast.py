@@ -113,6 +113,21 @@ class SelectStatement:
 
 
 @dataclasses.dataclass
+class CreateView:
+    """CREATE VIEW name AS SELECT ... (reference: sql3 CREATE VIEW,
+    sql3/parser createview statement)."""
+    name: str
+    select: "SelectStatement"
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropView:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class JoinClause:
     """One JOIN term (reference: sql3/parser ast.go JoinOperator +
     OnConstraint; sources form a left-deep chain here)."""

@@ -44,6 +44,7 @@ class SQLEngine:
     def __init__(self, api):
         self.api = api
         self.planner = Planner(api)
+        self.views = self.planner.views  # CREATE VIEW definitions
 
     def query(self, sql: str, parsed=None) -> SQLResult:
         t0 = time.monotonic()
@@ -70,6 +71,10 @@ class SQLEngine:
             return SQLResult(schema=op.schema, data=[list(r) for r in op.rows()])
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, ast.CreateView):
+            return self._create_view(stmt)
+        if isinstance(stmt, ast.DropView):
+            return self._drop_view(stmt)
         if isinstance(stmt, ast.DropTable):
             return self._drop_table(stmt)
         if isinstance(stmt, ast.AlterTable):
@@ -99,6 +104,10 @@ class SQLEngine:
             if ct.if_not_exists:
                 return SQLResult(schema=[], data=[])
             raise SQLError(f"table {ct.name!r} already exists")
+        if ct.name in self.views:
+            # views resolve before tables in plan_select; a shadowed
+            # table would be silently unreachable
+            raise SQLError(f"a view named {ct.name!r} already exists")
         id_cols = [c for c in ct.columns if c.name == "_id"]
         if not id_cols:
             raise SQLError("CREATE TABLE requires an _id column")
@@ -117,6 +126,25 @@ class SQLEngine:
             self.api.delete_index(ct.name)
             raise
         self.api.holder.save_schema()
+        return SQLResult(schema=[], data=[])
+
+    def _create_view(self, cv: ast.CreateView) -> SQLResult:
+        if cv.name in self.views or cv.name in self.api.holder.indexes:
+            if cv.if_not_exists:
+                return SQLResult(schema=[], data=[])
+            raise SQLError(f"view or table {cv.name!r} already exists")
+        # validate at definition time: the view must plan (unknown
+        # tables/columns fail HERE, not at first read)
+        self.planner.plan_select(cv.select)
+        self.views[cv.name] = cv.select
+        return SQLResult(schema=[], data=[])
+
+    def _drop_view(self, dv: ast.DropView) -> SQLResult:
+        if dv.name not in self.views:
+            if dv.if_exists:
+                return SQLResult(schema=[], data=[])
+            raise SQLError(f"view {dv.name!r} does not exist")
+        del self.views[dv.name]
         return SQLResult(schema=[], data=[])
 
     def _drop_table(self, d: ast.DropTable) -> SQLResult:

@@ -23,7 +23,7 @@ KEYWORDS = {
     "WITH", "SHOW", "TABLES", "COLUMNS", "DATABASES", "DELETE",
     "MIN", "MAX", "TIMEUNIT", "TIMEQUANTUM", "TTL", "CACHETYPE", "SIZE",
     "COMMENT", "KEYPARTITIONS", "EXTRACT", "CAST",
-    "JOIN", "INNER", "LEFT", "OUTER", "ON",
+    "JOIN", "INNER", "LEFT", "OUTER", "ON", "VIEW",
 }
 
 # multi-char operators first

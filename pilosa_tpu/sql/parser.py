@@ -170,8 +170,10 @@ class Parser:
             alias = self.ident()
         return ast.SelectItem(e, alias)
 
-    def create_table(self) -> ast.CreateTable:
+    def create_table(self):
         self.expect_kw("CREATE")
+        if self.accept_kw("VIEW"):
+            return self._create_view()
         self.expect_kw("TABLE")
         ine = False
         if self.accept_kw("IF"):
@@ -233,8 +235,25 @@ class Parser:
         v = int(self.next().value)
         return -v if neg else v
 
-    def drop_table(self) -> ast.DropTable:
+    def _create_view(self) -> ast.CreateView:
+        ine = False
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            ine = True
+        name = self.ident()
+        self.expect_kw("AS")
+        return ast.CreateView(name=name, select=self.select(),
+                              if_not_exists=ine)
+
+    def drop_table(self):
         self.expect_kw("DROP")
+        if self.accept_kw("VIEW"):
+            ife = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ife = True
+            return ast.DropView(name=self.ident(), if_exists=ife)
         self.expect_kw("TABLE")
         ife = False
         if self.accept_kw("IF"):

@@ -47,6 +47,15 @@ class _QueryCtx:
 class Planner:
     def __init__(self, api):
         self.api = api
+        # CREATE VIEW definitions (reference: sql3 CREATE VIEW; node-
+        # local, engine-lifetime). Shared with the SQLEngine.
+        self.views: Dict[str, ast.SelectStatement] = {}
+        # per-THREAD view expansion stack: the planner is shared across
+        # HTTP server threads, so a planner-level set would make
+        # concurrent reads of one view trip the cycle guard
+        import threading as _threading
+
+        self._expanding_local = _threading.local()
 
     # -- entry ---------------------------------------------------------------
 
@@ -55,6 +64,8 @@ class Planner:
             return self._select_no_table(s)
         if s.joins:
             return self._plan_join_select(s)
+        if s.table in self.views:
+            return self._plan_view_select(s)
         s = _strip_single_table_quals(s)
         ctx = _QueryCtx()
         idx = self.api.holder.index(s.table)
@@ -601,6 +612,93 @@ class Planner:
                     [plan.eval_expr(e, env) for _, _, e in hidden]
 
         return CallbackOp(schema, thunk, name="PQLGroupBy")
+
+    # -- views -----------------------------------------------------------------
+
+    def _plan_view_select(self, s: ast.SelectStatement) -> PlanOp:
+        """SELECT over a stored view: plan the view's definition, then
+        run the outer select host-side over its row stream (reference:
+        sql3 views compile to their definition as a subquery source).
+        PQL pushdown happens INSIDE the view's own plan; the outer
+        filter/aggregate layer operates on the reduced stream."""
+        name = s.table
+        expanding = getattr(self._expanding_local, "names", None)
+        if expanding is None:
+            expanding = self._expanding_local.names = set()
+        if name in expanding:
+            raise SQLError(f"circular view reference through {name!r}")
+        expanding.add(name)
+        try:
+            inner = self.plan_select(self.views[name])
+        finally:
+            expanding.discard(name)
+        s = _strip_single_table_quals(s)
+        types = dict(inner.schema)
+
+        def vtype(e: ast.Expr) -> str:
+            if isinstance(e, ast.ColumnRef):
+                if e.name not in types:
+                    raise SQLError(
+                        f"unknown column {e.name!r} in view {name!r}")
+                return types[e.name]
+            if isinstance(e, ast.FuncCall):
+                if e.name == "COUNT":
+                    return "INT"
+                if e.name in ("SUM", "MIN", "MAX", "PERCENTILE") and \
+                        e.args and isinstance(e.args[0], ast.ColumnRef):
+                    return vtype(e.args[0])
+                if e.name == "AVG":
+                    return "DECIMAL(4)"
+                return "INT"
+            if isinstance(e, ast.Literal):
+                return _literal_type(e.value)
+            return "INT"
+
+        items: List[ast.SelectItem] = []
+        for it in s.items:
+            if isinstance(it.expr, ast.Star):
+                items += [ast.SelectItem(ast.ColumnRef(n))
+                          for n, _ in inner.schema]
+            else:
+                items.append(it)
+        op: PlanOp = inner
+        if s.where is not None:
+            op = plan.FilterOp(op, s.where)
+        ctx = _QueryCtx()
+        aggs = _collect_aggs(items, s.having, s.order_by)
+        if s.group_by or aggs:
+            op = self._join_aggregate(op, items, s.group_by, s.having,
+                                      aggs, vtype, ctx, bool(s.order_by))
+        else:
+            proj = [(self._item_name(it, i), vtype(it.expr), it.expr)
+                    for i, it in enumerate(items)]
+            names = {p[0] for p in proj}
+            for t in s.order_by:
+                for r in _qualified_refs(t.expr):
+                    if r.name not in names:
+                        ctx.hidden.append((r.name, vtype(r),
+                                           ast.ColumnRef(r.name)))
+                        names.add(r.name)
+            op = plan.ProjectOp(op, proj + ctx.hidden)
+        if s.order_by:
+            by_item = {repr(it.expr): self._item_name(it, i)
+                       for i, it in enumerate(items)}
+            terms = []
+            for t in s.order_by:
+                if repr(t.expr) in by_item:
+                    terms.append((ast.ColumnRef(by_item[repr(t.expr)]),
+                                  t.desc))
+                else:
+                    terms.append((_rewrite_ctx(t.expr, ctx), t.desc))
+            op = plan.OrderByOp(op, terms)
+            if ctx.hidden:
+                op = _TrimOp(op, len(op.schema) - len(ctx.hidden))
+        if s.distinct:
+            op = plan.DistinctOp(op)
+        limit = s.limit if s.limit is not None else s.top
+        if limit is not None or s.offset:
+            op = plan.LimitOp(op, limit, s.offset)
+        return op
 
     # -- JOIN ------------------------------------------------------------------
 

@@ -198,3 +198,73 @@ class TestRestoreSafety:
         api2.restore_tar(io.BytesIO(out.getvalue()))
         assert not (tmp_path / "pwned").exists(), "restore unpickled a WAL"
         assert api2.query("i", "Row(f=1)")[0].columns == [3]
+
+
+class TestDatagen:
+    def test_scenarios_ingest_in_process(self):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.ingest.datagen import scenario, scenarios
+        from pilosa_tpu.ingest.ingest import Ingester
+
+        assert {"customer", "bank", "equipment",
+                "kitchen-sink"} <= set(scenarios())
+        api = API()
+        n = Ingester(api, "cust", scenario("customer", rows=200)).run()
+        assert n == 200
+        # deterministic: same seed, same data
+        api2 = API()
+        Ingester(api2, "cust", scenario("customer", rows=200)).run()
+        assert api.query("cust", "Sum(field=ltv)")[0].val == \
+            api2.query("cust", "Sum(field=ltv)")[0].val
+        assert api.query("cust", "Count(All())")[0] == 200
+
+    def test_datagen_cli_remote(self):
+        import sys
+
+        from pilosa_tpu.api import API
+        from pilosa_tpu.ctl.cli import main
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        srv, _ = serve(api, port=0, background=True)
+        try:
+            base = f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+            rc = main(["datagen", "--scenario", "bank", "--rows", "300",
+                       "--index", "txns", "--host", base])
+            assert rc == 0
+            assert api.query("txns", "Count(All())")[0] == 300
+            top = api.query("txns", "TopN(category, n=1)")[0]
+            assert top.pairs[0].count > 0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestQueryLogger:
+    def test_query_log_records_pql_and_sql(self, tmp_path):
+        from pilosa_tpu.api import API
+        from pilosa_tpu.obs.logger import CaptureLogger
+
+        api = API()
+        api.set_query_logger(str(tmp_path / "queries.jsonl"))
+        api.create_index("t")
+        api.create_field("t", "f", {"type": "set"})
+        api.query("t", "Set(1, f=2)")
+        api.query("t", "Count(Row(f=2))")
+        api.sql("select count(*) from t")
+        try:
+            api.query("t", "Bogus(")
+        except Exception:
+            pass
+        recs = api.query_logger.tail()
+        kinds = [(r["kind"], "error" in r) for r in recs]
+        assert ("pql", False) in kinds and ("sql", False) in kinds
+        assert ("pql", True) in kinds  # the failed parse is logged too
+        assert all("duration_ms" in r for r in recs)
+        assert any(r["query"] == "Count(Row(f=2))" for r in recs)
+        # CaptureLogger captures module logs (reference: CaptureLogger)
+        with CaptureLogger("mesh") as cap:
+            from pilosa_tpu.obs.logger import get_logger
+
+            get_logger("mesh").warning("hello %d", 7)
+        assert cap.lines == ["hello 7"]

@@ -359,3 +359,58 @@ class TestReviewRegressions:
         api.sql("insert into es values (1, [])")
         assert api.sql("select count(*) from es").data == [[1]]
         assert api.sql("select _id from es").data == [[1]]
+
+
+class TestViews:
+    """CREATE VIEW / DROP VIEW (reference: sql3 CREATE VIEW,
+    defs_views.go behaviors)."""
+
+    @pytest.fixture()
+    def api(self):
+        api = API()
+        api.sql("create table base (_id id, seg id, n int)")
+        api.sql("insert into base values (1, 10, 5), (2, 10, 7), "
+                "(3, 20, 1), (4, 20, 3)")
+        return api
+
+    def test_view_select(self, api):
+        api.sql("create view big as select _id, seg, n from base "
+                "where n > 2")
+        assert sorted(api.sql("select _id from big").data) == \
+            [[1], [2], [4]]
+        # outer WHERE + projection over the view
+        assert api.sql("select _id from big where seg = 10").data in \
+            ([[1], [2]], [[2], [1]])
+        assert api.sql("select count(*) from big").data == [[3]]
+
+    def test_view_aggregate_and_order(self, api):
+        api.sql("create view v as select seg, n from base")
+        out = api.sql("select seg, sum(n) from v group by seg "
+                      "order by sum(n) desc")
+        assert out.data == [[10, 12], [20, 4]]
+
+    def test_view_of_view_and_cycle_guard(self, api):
+        api.sql("create view v1 as select _id, n from base where n > 1")
+        api.sql("create view v2 as select _id from v1 where n > 4")
+        assert sorted(api.sql("select _id from v2").data) == [[1], [2]]
+        # cycle: v3 -> v3 rejected at definition (validation plans it)
+        with pytest.raises(Exception):
+            api.sql("create view v3 as select _id from v3")
+
+    def test_view_ddl_semantics(self, api):
+        api.sql("create view v as select _id from base")
+        with pytest.raises(Exception):
+            api.sql("create view v as select _id from base")
+        api.sql("create view if not exists v as select _id from base")
+        api.sql("drop view v")
+        with pytest.raises(Exception):
+            api.sql("select _id from v")
+        api.sql("drop view if exists v")
+        with pytest.raises(Exception):
+            api.sql("drop view v")
+
+    def test_view_validates_at_definition(self, api):
+        with pytest.raises(Exception):
+            api.sql("create view bad as select nope from base")
+        with pytest.raises(Exception):
+            api.sql("create view bad2 as select _id from missing_table")
