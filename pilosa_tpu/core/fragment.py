@@ -33,6 +33,39 @@ from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
 
 _MIN_CAPACITY = 8
 
+# Paranoia mode (reference: roaring/roaring_paranoia.go build tag — opt-in
+# invariant re-validation on every mutation; here env-gated so production
+# pays nothing). PILOSA_TPU_PARANOIA=1 enables.
+import os as _os
+
+PARANOIA = _os.environ.get("PILOSA_TPU_PARANOIA", "").lower() in (
+    "1", "true", "yes", "on")
+
+
+def _paranoia_set(frag: "SetFragment") -> None:
+    assert len(frag.row_ids) == len(frag.row_index), \
+        "row_ids/row_index length mismatch"
+    for slot, row in enumerate(frag.row_ids):
+        assert frag.row_index[row] == slot, f"slot map broken for row {row}"
+    assert frag.planes.shape[0] >= len(frag.row_ids), "capacity underflow"
+    assert frag.planes.dtype == np.uint32
+    # padding slots must stay zero (stacks rely on it for gather fill)
+    if frag.planes.shape[0] > len(frag.row_ids):
+        assert not frag.planes[len(frag.row_ids):].any(), \
+            "dirty padding slot"
+
+
+def _paranoia_bsi(frag: "BSIFragment") -> None:
+    assert frag.planes.shape[0] == bsiops.OFFSET + frag.depth, \
+        "plane count != 2 + depth"
+    exists = frag.planes[bsiops.EXISTS]
+    # sign/magnitude bits only where a value exists
+    for k in range(frag.planes.shape[0]):
+        if k == bsiops.EXISTS:
+            continue
+        assert not (frag.planes[k] & ~exists).any(), \
+            f"plane {k} has bits outside the existence plane"
+
 # Write-delta log bounds (the incremental device-merge path,
 # core/stacked.py): more pending ops than this and a full re-stack is
 # cheaper than scattering, so the log resets and the next stack build
@@ -152,6 +185,8 @@ class SetFragment:
         self.planes[s, w] = old | mask
         self.version += 1
         self.deltas.record(self.version, (row, (col,), ()))
+        if PARANOIA:
+            _paranoia_set(self)
         return True
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -166,6 +201,8 @@ class SetFragment:
         self.planes[s, w] = old & ~mask
         self.version += 1
         self.deltas.record(self.version, (row, (), (col,)))
+        if PARANOIA:
+            _paranoia_set(self)
         return True
 
     def set_many(self, rows: Sequence[int], cols: Sequence[int]) -> int:
@@ -197,6 +234,8 @@ class SetFragment:
                     # version), so recording them only burns the fresh
                     # log's budget
                     break
+        if PARANOIA:
+            _paranoia_set(self)
         return changed
 
     def clear_column(self, col: int, except_row: Optional[int] = None) -> bool:
@@ -216,6 +255,8 @@ class SetFragment:
         self.version += 1
         for slot in np.nonzero(to_clear)[0]:
             self.deltas.record(self.version, (self.row_ids[slot], (), (col,)))
+        if PARANOIA:
+            _paranoia_set(self)
         return True
 
     def import_row_plane(self, row: int, plane: np.ndarray, clear: bool = False):
@@ -228,6 +269,8 @@ class SetFragment:
             self.planes[s] |= plane
         self.version += 1
         self.deltas.reset(self.version)  # bulk plane op: not delta-replayable
+        if PARANOIA:
+            _paranoia_set(self)
 
     def clear_row_plane_bits(self, row: int, plane: np.ndarray) -> bool:
         """Clear the bits of ``plane`` from a row; no-op (and no slot
@@ -238,6 +281,8 @@ class SetFragment:
         self.planes[s] &= ~plane
         self.version += 1
         self.deltas.reset(self.version)
+        if PARANOIA:
+            _paranoia_set(self)
         return True
 
     def clear_plane(self, plane: np.ndarray) -> None:
@@ -250,6 +295,8 @@ class SetFragment:
         self.planes[:n] &= ~plane
         self.version += 1
         self.deltas.reset(self.version)
+        if PARANOIA:
+            _paranoia_set(self)
 
     # -- host read path ----------------------------------------------------
 
@@ -340,6 +387,8 @@ class BSIFragment:
         update = bsiops.encode_values(cols, values, self.depth, self.words)
         self.planes[: update.shape[0]] |= update
         self.version += 1
+        if PARANOIA:
+            _paranoia_bsi(self)
         if grew:
             self.deltas.reset(self.version)
         else:
@@ -359,6 +408,8 @@ class BSIFragment:
         self.version += 1
         self.deltas.record(self.version, ("clear", col),
                            cost=bsiops.OFFSET + self.depth)
+        if PARANOIA:
+            _paranoia_bsi(self)
         return True
 
     def value(self, col: int) -> Optional[int]:
@@ -384,6 +435,8 @@ class BSIFragment:
         self.planes &= ~plane[None, :]
         self.version += 1
         self.deltas.reset(self.version)
+        if PARANOIA:
+            _paranoia_bsi(self)
 
     def device_planes(self) -> jax.Array:
         if self._device is None or self._device_version != self.version:

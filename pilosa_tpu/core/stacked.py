@@ -170,6 +170,16 @@ class DeviceBudget:
             if old is not None:
                 self.used -= old[0]
 
+    def audit(self) -> None:
+        """Accounting invariants (the testhook auditor analog,
+        reference: testhook/auditor.go): the byte counter must equal the
+        sum of resident entries — a drift means a leak or double-release
+        somewhere in the charge/evict/release protocol."""
+        with self._lock:
+            total = sum(b for b, _ in self._lru.values())
+            assert total == self.used, (
+                f"DeviceBudget drift: used={self.used} entries={total}")
+
 
 #: Default HBM budget for paged blocks (v5e has 16 GiB; leave headroom
 #: for unpaged stacks, kernel workspace and XLA constants).

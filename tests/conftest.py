@@ -26,3 +26,14 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _budget_leak_audit():
+    """Post-test accounting audit (the reference's testhook auditors,
+    testhook/hook.go:22: every test leaves shared registries
+    consistent)."""
+    yield
+    from pilosa_tpu.core import stacked as _stx
+
+    _stx.BUDGET.audit()

@@ -257,3 +257,42 @@ class TestIndexHolder:
         # Replayed stores keep allocating fresh IDs.
         dave = idx2.translate.create_keys(["dave"])["dave"]
         assert dave not in {ids["alice"], ids["bob"], again["carol"]}
+
+
+class TestParanoia:
+    """Opt-in invariant re-validation (reference: roaringparanoia /
+    roaringsentinel build tags, SURVEY §5.2)."""
+
+    def test_paranoia_catches_corruption(self, monkeypatch):
+        from pilosa_tpu.core import fragment as fragmod
+
+        monkeypatch.setattr(fragmod, "PARANOIA", True)
+        frag = fragmod.SetFragment(0)
+        frag.set_bit(1, 5)  # healthy mutation passes
+        frag.row_index[99] = 7  # corrupt the slot map
+        with pytest.raises(AssertionError):
+            frag.set_bit(1, 6)
+
+    def test_paranoia_bsi_exists_invariant(self, monkeypatch):
+        import numpy as np
+
+        from pilosa_tpu.core import fragment as fragmod
+        from pilosa_tpu.ops import bsi as bsiops
+
+        monkeypatch.setattr(fragmod, "PARANOIA", True)
+        frag = fragmod.BSIFragment(0)
+        frag.set_values([1, 2], [3, 4])
+        # magnitude bit without existence = corruption
+        frag.planes[bsiops.OFFSET, 100] = np.uint32(1)
+        with pytest.raises(AssertionError):
+            frag.set_values([3], [5])
+
+    def test_budget_audit_detects_drift(self):
+        from pilosa_tpu.core.stacked import DeviceBudget
+
+        b = DeviceBudget(1 << 20)
+        b.charge(("x", 0), 100, lambda: None)
+        b.audit()
+        b.used += 7  # simulated leak
+        with pytest.raises(AssertionError):
+            b.audit()
