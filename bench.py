@@ -33,6 +33,24 @@ import numpy as np
 
 QUERY_ITERS = 20
 
+# CPU-fallback scaling: when the accelerator is unreachable the suite
+# still must finish inside the driver budget, so configs shrink and the
+# metric labels say so (a scaled CPU number is a smoke signal, not a
+# perf claim).
+SCALE = 1.0
+SCALED = ""
+
+
+def _apply_cpu_scale() -> None:
+    global SCALE, SCALED, QUERY_ITERS
+    SCALE = 0.125
+    SCALED = " cpu-scaled"
+    QUERY_ITERS = 5
+
+
+def _n(x: int) -> int:
+    return max(1, int(x * SCALE))
+
 
 def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
     print(json.dumps({
@@ -43,7 +61,10 @@ def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
     }), flush=True)
 
 
-def _p50_ms(fn, iters: int = QUERY_ITERS) -> float:
+def _p50_ms(fn, iters: int = 0) -> float:
+    iters = iters or QUERY_ITERS  # read the global at CALL time so the
+    # CPU-fallback rescale actually applies (a default arg binds at
+    # import, before _apply_cpu_scale runs)
     fn()  # warm: compile + upload
     times = []
     for _ in range(iters):
@@ -76,7 +97,7 @@ def bench_config1(device: str) -> None:
     from pilosa_tpu.ingest.source import CSVSource
 
     rng = np.random.default_rng(1)
-    n = 1_000_000
+    n = _n(1_000_000)
     city = rng.integers(0, 1000, n)
     dev = rng.integers(0, 10, n)
     lines = ["id,city__IS,device__IS"]
@@ -98,8 +119,8 @@ def bench_config1(device: str) -> None:
                    batch_size=131072).run()
     ingest_s = time.perf_counter() - t0
     assert got == n, got
-    _emit(f"c1_csv_ingest_1M_rows ({device})", n / ingest_s, "rows/s",
-          (n / ingest_s) / (n / parse_s))
+    _emit(f"c1_csv_ingest_1M_rows{SCALED} ({device})", n / ingest_s,
+          "rows/s", (n / ingest_s) / (n / parse_s))
 
     # query: Intersect+Count of two rows (executor.go:5357 hot path)
     q = "Count(Intersect(Row(city=7), Row(device=3)))"
@@ -116,8 +137,8 @@ def bench_config1(device: str) -> None:
     for _ in range(QUERY_ITERS):
         _np_popcount(pa & pb)
     base_ms = (time.perf_counter() - t0) / QUERY_ITERS * 1e3
-    _emit(f"c1_intersect_count_p50_1shard_1Mrows ({device})", p50, "ms",
-          base_ms / p50)
+    _emit(f"c1_intersect_count_p50_1shard_1Mrows{SCALED} ({device})", p50,
+          "ms", base_ms / p50)
 
 
 # ---------------------------------------------------------------------------
@@ -131,7 +152,7 @@ def bench_config2(device: str) -> None:
     from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
     rng = np.random.default_rng(2)
-    shards, depth = 10, 20
+    shards, depth = _n(10), 20
     h = Holder()
     idx = h.create_index("b")
     idx.create_field("amount", FieldOptions(type=FieldType.INT))
@@ -171,8 +192,8 @@ def bench_config2(device: str) -> None:
         count += _np_popcount(gt)
     base_ms = (time.perf_counter() - t0) * 1e3
     assert res.count == count and res.val == total, (res, count, total)
-    _emit(f"c2_bsi_range_sum_p50_10Mrows_{depth}bit ({device})", p50, "ms",
-          base_ms / p50)
+    _emit(f"c2_bsi_range_sum_p50_10Mrows_{depth}bit{SCALED} ({device})",
+          p50, "ms", base_ms / p50)
 
 
 # ---------------------------------------------------------------------------
@@ -185,7 +206,7 @@ def bench_config4(device: str) -> None:
     from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
     rng = np.random.default_rng(4)
-    shards, rows = 256, 4
+    shards, rows = _n(256), 4
     months = [f"standard_2010{m:02d}" for m in range(1, 13)]
     h = Holder()
     idx = h.create_index("t")
@@ -215,8 +236,8 @@ def bench_config4(device: str) -> None:
     want = _np_popcount(acc)
     base_ms = (time.perf_counter() - t0) * 1e3
     assert got == want, (got, want)
-    _emit(f"c4_timequantum_row_count_p50_256shards ({device})", p50, "ms",
-          base_ms / p50)
+    _emit(f"c4_timequantum_row_count_p50_256shards{SCALED} ({device})",
+          p50, "ms", base_ms / p50)
 
 
 # ---------------------------------------------------------------------------
@@ -228,7 +249,7 @@ def bench_config5(device: str) -> None:
     from pilosa_tpu.shardwidth import SHARD_WIDTH
 
     rng = np.random.default_rng(5)
-    shards = 64
+    shards = _n(64)
     api = API()
     api.create_index("df")
     cols = {}
@@ -249,8 +270,8 @@ def bench_config5(device: str) -> None:
         want += float(np.sum(fare + dist * 2))
     base_ms = (time.perf_counter() - t0) * 1e3
     assert abs(got.value - want) / abs(want) < 1e-3, (got.value, want)
-    _emit(f"c5_dataframe_apply_sum_p50_67Mrows ({device})", p50, "ms",
-          base_ms / p50)
+    _emit(f"c5_dataframe_apply_sum_p50_67Mrows{SCALED} ({device})", p50,
+          "ms", base_ms / p50)
 
 
 # ---------------------------------------------------------------------------
@@ -263,7 +284,8 @@ def bench_config3(device: str) -> None:
     from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
     rng = np.random.default_rng(3)
-    shards, years, brands = 6, 7, 1000  # lineorder SF-1: ~6M rows
+    # lineorder SF-1: ~6M rows (scaled down on the CPU fallback)
+    shards, years, brands = max(2, _n(6)), 7, _n(1000)
     h = Holder()
     idx = h.create_index("ssb")
     fy = idx.create_field("year")
@@ -300,8 +322,8 @@ def bench_config3(device: str) -> None:
         np.dot(yl.astype(np.float32), bl.astype(np.float32).T)
         _BYTE_POP[ba[s].view(np.uint8)].sum(axis=-1)
     base_ms = (time.perf_counter() - t0) * 1e3
-    _emit(f"c3_groupby_topk_p50_ssb_sf1_{shards}shards_{years}x{brands} "
-          f"({device})", p50, "ms", base_ms / p50)
+    _emit(f"c3_groupby_topk_p50_ssb_sf1_{shards}shards_{years}x{brands}"
+          f"{SCALED} ({device})", p50, "ms", base_ms / p50)
 
 
 def _select_backend() -> None:
@@ -346,6 +368,8 @@ def main() -> None:
     import jax
 
     device = jax.devices()[0].device_kind
+    if jax.devices()[0].platform == "cpu":
+        _apply_cpu_scale()
     # headline config (3) runs LAST so its line is what the driver parses
     for cfg in (bench_config1, bench_config2, bench_config4,
                 bench_config5, bench_config3):

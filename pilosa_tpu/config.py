@@ -16,6 +16,20 @@ from typing import Any, Dict, List, Optional
 _ENV_PREFIX = "PILOSA_TPU_"
 
 
+
+def _truthy(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "t", "yes", "on")
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """The one boolean-env dialect (shared by config parsing and opt-in
+    feature flags like PILOSA_TPU_PARANOIA)."""
+    import os
+
+    raw = os.environ.get(name)
+    return default if raw is None else _truthy(raw)
+
+
 @dataclasses.dataclass
 class Config:
     # listener
@@ -69,7 +83,7 @@ class Config:
             elif f.type in ("float", float):
                 v = float(v)
             elif f.type in ("bool", bool) and isinstance(v, str):
-                v = v.strip().lower() in ("1", "true", "t", "yes")
+                v = _truthy(v)
             elif "List" in str(f.type) and isinstance(v, str):
                 v = [p for p in v.split(",") if p]
             setattr(self, f.name, v)

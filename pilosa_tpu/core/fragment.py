@@ -36,10 +36,9 @@ _MIN_CAPACITY = 8
 # Paranoia mode (reference: roaring/roaring_paranoia.go build tag — opt-in
 # invariant re-validation on every mutation; here env-gated so production
 # pays nothing). PILOSA_TPU_PARANOIA=1 enables.
-import os as _os
+from pilosa_tpu.config import env_bool as _env_bool
 
-PARANOIA = _os.environ.get("PILOSA_TPU_PARANOIA", "").lower() in (
-    "1", "true", "yes", "on")
+PARANOIA = _env_bool("PILOSA_TPU_PARANOIA")
 
 
 def _paranoia_set(frag: "SetFragment") -> None:
