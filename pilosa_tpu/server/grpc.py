@@ -25,6 +25,11 @@ from pilosa_tpu.server import proto
 SERVICE = "pilosa.Pilosa"
 
 
+class UnknownGRPCMethod(KeyError):
+    """Distinguishes 'no such rpc' (UNIMPLEMENTED) from KeyErrors raised
+    by the service logic (NOT_FOUND, e.g. a missing index)."""
+
+
 def _sql_headers(schema) -> List[Tuple[str, str]]:
     return [(n, t) for n, t in schema]
 
@@ -136,6 +141,78 @@ class PilosaServicer:
         return proto.encode_table_response(headers, rows,
                                            time.monotonic_ns() - t0)
 
+    def inspect(self, req: dict) -> Iterator[bytes]:
+        """Inspect: per-record field values for chosen columns
+        (reference: grpc.go Inspect — an Extract over the given record
+        ids/keys, optionally restricted to filterFields and/or filtered
+        by a PQL row query)."""
+        from pilosa_tpu.core.schema import FieldType
+        from pilosa_tpu.pql.executor import has_write_calls
+        from pilosa_tpu.pql.parser import parse
+
+        index = req["index"]
+        idx = self.api.holder.index(index)
+        known = {f.name for f in idx.public_fields()}
+        for f in req["filterFields"]:
+            # strict validation: field names are interpolated into PQL
+            if f not in known:
+                raise KeyError(f"unknown field {f!r}")
+        fields = req["filterFields"] or sorted(known)
+        if req["keys"]:
+            cols = ", ".join(
+                "'" + k.replace("\\", "\\\\").replace("'", "\\'") + "'"
+                for k in req["keys"])
+        else:
+            cols = ", ".join(str(int(i)) for i in req["ids"])
+        if req["query"]:
+            q = parse(req["query"])
+            if has_write_calls(q):
+                raise ValueError("Inspect query must be read-only")
+            target = req["query"]
+            if cols:
+                target = f"Intersect({target}, ConstRow(columns=[{cols}]))"
+        else:
+            target = f"ConstRow(columns=[{cols}])" if cols else "All()"
+        rows_calls = "".join(f", Rows({f})" for f in fields)
+        pql = f"Extract({target}{rows_calls})"
+        table = self.api.query(index, pql)[0]
+        ftypes = {f: idx.field(f).options for f in fields}
+        headers = [("_id", "STRING" if idx.options.keys else "ID")]
+        for ef in table.fields:
+            fo = ftypes[ef.name]
+            if fo.type == FieldType.DECIMAL:
+                dt = f"DECIMAL({fo.scale})"
+            else:
+                dt = {"int": "INT", "bool": "BOOL",
+                      "timestamp": "TIMESTAMP"}.get(
+                    ef.type, "STRING" if fo.keys else "ID")
+            headers.append((ef.name, dt))
+        types = [t for _, t in headers]
+        offset, limit = int(req["offset"]), int(req["limit"])
+        out_cols = table.columns[offset:]
+        if limit:
+            out_cols = out_cols[:limit]
+        scalar = {f: ftypes[f].type in (FieldType.MUTEX, FieldType.BOOL)
+                  for f in fields}
+
+        def conv(fname: str, v):
+            if scalar[fname] and isinstance(v, list):
+                v = v[0] if v else None
+                if v is not None and ftypes[fname].type == FieldType.BOOL:
+                    v = bool(v)
+            return v
+
+        first = True
+        for col in out_cols:
+            ident = col.key if col.key is not None else col.column
+            row = [ident] + [conv(f, v)
+                             for f, v in zip(fields, col.rows)]
+            yield proto.encode_row_response(
+                headers if first else [], row, types)
+            first = False
+        if first:
+            yield proto.encode_row_response(headers, [], types)
+
     # -- index CRUD (reference: grpc.go CreateIndex/GetIndexes/...) --------
 
     def create_index(self, name: str, keys: bool) -> bytes:
@@ -186,7 +263,9 @@ class PilosaServicer:
         if method == "DeleteIndex":
             req = proto.decode_name_request(request)
             return [self.delete_index(req["name"])]
-        raise KeyError(f"unknown gRPC method {method!r}")
+        if method == "Inspect":
+            return list(self.inspect(proto.decode_inspect_request(request)))
+        raise UnknownGRPCMethod(f"unknown gRPC method {method!r}")
 
 
 # -- gRPC message framing (shared with HTTP fallback) -------------------------
@@ -243,7 +322,7 @@ def serve_grpc(api, host: str = "127.0.0.1", port: int = 20101):
     for m in ("QuerySQLUnary", "QueryPQLUnary", "CreateIndex",
               "GetIndexes", "GetIndex", "DeleteIndex"):
         handlers[m] = unary(m)
-    for m in ("QuerySQL", "QueryPQL"):
+    for m in ("QuerySQL", "QueryPQL", "Inspect"):
         handlers[m] = streaming(m)
     from concurrent.futures import ThreadPoolExecutor
 

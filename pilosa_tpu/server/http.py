@@ -467,11 +467,16 @@ class Handler(BaseHTTPRequestHandler):
         parsed_sql = None
         if self.auth is not None:
             parsed_sql = self._authorize_grpc(method, request)
+        from pilosa_tpu.server.grpc import UnknownGRPCMethod
+
         try:
             responses = PilosaServicer(self.api).call(
                 method, request, parsed_sql=parsed_sql)
-        except KeyError as e:
+        except UnknownGRPCMethod as e:
             self._send_grpc(b"", status=12, message=str(e))  # UNIMPLEMENTED
+            return
+        except KeyError as e:
+            self._send_grpc(b"", status=5, message=str(e))  # NOT_FOUND
             return
         except Exception as e:
             self._send_grpc(b"", status=13, message=str(e))  # INTERNAL
@@ -498,6 +503,11 @@ class Handler(BaseHTTPRequestHandler):
         elif method in ("QuerySQL", "QuerySQLUnary"):
             req = P.decode_query_sql_request(request)
             return self._authorize_sql(req["sql"])
+        elif method == "Inspect":
+            req = P.decode_inspect_request(request)
+            self.auth.authorize(ctx, "read", req["index"])
+        elif method in ("GetIndex", "GetIndexes"):
+            pass  # names only; route-level read suffices
         return None
 
     def _send_grpc(self, payload: bytes, status: int = 0,

@@ -127,6 +127,41 @@ def decode_name_request(buf: bytes) -> Dict[str, Any]:
     return out
 
 
+def decode_inspect_request(buf: bytes) -> Dict[str, Any]:
+    """InspectRequest{index=1, columns(IdsOrKeys)=2, filterFields=3,
+    limit=4, offset=5, query=6}."""
+    out: Dict[str, Any] = {"index": "", "ids": [], "keys": [],
+                           "filterFields": [], "limit": 0, "offset": 0,
+                           "query": ""}
+    for field, _, v in iter_fields(buf):
+        if field == 1:
+            out["index"] = v.decode()
+        elif field == 2:
+            for f2, _, v2 in iter_fields(v):
+                for f3, wt3, v3 in iter_fields(v2):
+                    if f3 != 1:
+                        continue
+                    if f2 == 1:
+                        if wt3 == _LEN:  # packed (proto3 default)
+                            j = 0
+                            while j < len(v3):
+                                val, j = _decode_varint(v3, j)
+                                out["ids"].append(val)
+                        else:
+                            out["ids"].append(v3)
+                    elif f2 == 2:
+                        out["keys"].append(v3.decode())
+        elif field == 3:
+            out["filterFields"].append(v.decode())
+        elif field == 4:
+            out["limit"] = v
+        elif field == 5:
+            out["offset"] = v
+        elif field == 6:
+            out["query"] = v.decode()
+    return out
+
+
 # -- responses (encode) -------------------------------------------------------
 
 def encode_column_info(name: str, datatype: str) -> bytes:

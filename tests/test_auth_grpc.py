@@ -386,3 +386,61 @@ class TestAuthPrecision:
         code, _ = _req(base, "POST", "/sql",
                        b"select count(*) from secret", tok)
         assert code == 403
+
+
+class TestGRPCInspect:
+    def test_inspect_streams_records(self):
+        api = API()
+        api.sql("create table ins (_id id, seg id, n int)")
+        api.sql("insert into ins values (1, 10, 5), (2, 20, 7), (3, 10, 9)")
+        s = PilosaServicer(api)
+        # columns(field 2) = IdsOrKeys{ids(1)=Uint64Array{vals(1)=[1,3]}}
+        ids = proto._len_field(2, proto._len_field(
+            1, b"".join(proto._tag(1, 0) + proto._encode_varint(x)
+                        for x in (1, 3))))
+        req = proto._str_field(1, "ins") + ids
+        msgs = s.call("Inspect", req)
+        assert len(msgs) == 2
+        h0, r0 = proto.decode_row_response(msgs[0])
+        assert [n for n, _ in h0] == ["_id", "n", "seg"]
+        assert r0 == [1, 5, 10]
+        _, r1 = proto.decode_row_response(msgs[1])
+        assert r1 == [3, 9, 10]
+        # filterFields restricts columns
+        req2 = proto._str_field(1, "ins") + ids + proto._str_field(3, "n")
+        h, r = proto.decode_row_response(s.call("Inspect", req2)[0])
+        assert [n for n, _ in h] == ["_id", "n"] and r == [1, 5]
+
+    def test_inspect_query_filter_packed_ids_and_errors(self):
+        api = API()
+        api.sql("create table iq (_id id, seg id, n int)")
+        api.sql("insert into iq values (1, 10, 5), (2, 20, 7), (3, 10, 9)")
+        s = PilosaServicer(api)
+        # query filter, no ids
+        req = (proto._str_field(1, "iq") +
+               proto._str_field(6, "Row(seg=10)"))
+        msgs = s.call("Inspect", req)
+        assert len(msgs) == 2
+        # packed ids (proto3 default from real protoc clients)
+        packed = proto._len_field(2, proto._len_field(
+            1, proto._len_field(1, bytes([1, 3]))))
+        msgs = s.call("Inspect", proto._str_field(1, "iq") + packed)
+        assert len(msgs) == 2
+        _, r0 = proto.decode_row_response(msgs[0])
+        assert r0[0] == 1
+        # injection via filterFields is rejected
+        bad = (proto._str_field(1, "iq") +
+               proto._str_field(3, "n)) Delete(All()"))
+        with pytest.raises(KeyError):
+            s.call("Inspect", bad)
+        assert api.sql("select count(*) from iq").data == [[3]]
+        # write query rejected
+        with pytest.raises(ValueError):
+            s.call("Inspect", proto._str_field(1, "iq") +
+                   proto._str_field(6, "Delete(All())"))
+        # decimal scale honored in headers
+        api.sql("create table dq (_id id, d decimal(2))")
+        api.sql("insert into dq values (1, 1.25)")
+        h, r = proto.decode_row_response(
+            s.call("Inspect", proto._str_field(1, "dq"))[0])
+        assert ("d", "DECIMAL(2)") in h and r == [1, 1.25]
