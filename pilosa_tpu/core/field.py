@@ -16,7 +16,7 @@ from typing import Dict, Iterable, List, Optional, Set
 import numpy as np
 
 from pilosa_tpu.core import timeq
-from pilosa_tpu.core.fragment import BSIFragment, SetFragment
+from pilosa_tpu.core.fragment import BSIFragment, SetFragment, group_sorted
 from pilosa_tpu.core.schema import (
     BOOL_FALSE_ROW,
     BOOL_TRUE_ROW,
@@ -24,7 +24,7 @@ from pilosa_tpu.core.schema import (
     FieldType,
 )
 from pilosa_tpu.core.translate import TranslateStore
-from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP
 
 _TIME_UNITS_PER_S = {"s": 1, "ms": 1000, "us": 1_000_000, "ns": 1_000_000_000}
 
@@ -164,22 +164,47 @@ class Field:
     def set_value(self, col: int, value) -> None:
         self.set_values([col], [value])
 
+    def _to_stored_bulk(self, values) -> np.ndarray:
+        """Vectorized to_stored for int/decimal columns; element-wise
+        fallback (timestamps, mixed types) otherwise. Validates (min/max
+        bounds raise here) exactly like to_stored."""
+        t = self.options.type
+        try:
+            if t == FieldType.INT:
+                out = np.asarray(values, dtype=np.int64)
+            elif t == FieldType.DECIMAL:
+                out = np.round(np.asarray(values, dtype=np.float64)
+                               * (10 ** self.options.scale)).astype(np.int64)
+                return out - self.options.base
+            else:
+                raise TypeError
+        except (TypeError, ValueError, OverflowError):
+            return np.array([self.to_stored(v) for v in values],
+                            dtype=np.int64)
+        if self.options.min is not None and (out < self.options.min).any():
+            bad = int(out[out < self.options.min][0])
+            raise ValueError(f"value {bad} < field min {self.options.min}")
+        if self.options.max is not None and (out > self.options.max).any():
+            bad = int(out[out > self.options.max][0])
+            raise ValueError(f"value {bad} > field max {self.options.max}")
+        return out - self.options.base
+
     def set_values(self, cols: Iterable[int], values: Iterable) -> None:
-        cols = list(cols)
-        values = list(values)
-        by_shard: Dict[int, tuple] = {}
+        cols = np.asarray(cols, dtype=np.int64).ravel()
         # Convert (and validate: min/max bounds raise here) BEFORE logging
         # so a rejected write never poisons the WAL for replay.
-        for col, val in zip(cols, values):
-            shard, pos = divmod(col, SHARD_WIDTH)
-            by_shard.setdefault(shard, ([], []))
-            by_shard[shard][0].append(pos)
-            by_shard[shard][1].append(self.to_stored(val))
+        if not isinstance(values, (list, tuple, np.ndarray)):
+            values = list(values)
+        stored = self._to_stored_bulk(values)
+        if cols.size != stored.size:
+            raise ValueError("cols and values must be the same length")
         # Log *external* values so replay runs through to_stored again
         # (deterministic; keeps decimal/timestamp conversion in one place).
-        self._log("set_values", self.name, cols, values)
-        for shard, (poss, vals) in by_shard.items():
-            self.bsi_fragment(shard, create=True).set_values(poss, vals)
+        self._log("set_values", self.name, cols, np.asarray(values))
+        shards = cols >> SHARD_WIDTH_EXP
+        pos = cols & (SHARD_WIDTH - 1)
+        for shard, (p, v) in group_sorted(shards, pos, stored):
+            self.bsi_fragment(shard, create=True).set_values(p, v)
 
     def clear_value(self, col: int) -> bool:
         self._log("clear_value", self.name, col)
@@ -192,31 +217,36 @@ class Field:
         """Bulk (row, col) import with IDs already translated (reference:
         fragment.go:1498 bulkImport; mutex variant :1787). Returns changed
         bit count. The one bulk WAL record replaces per-bit logging."""
-        rows = [int(r) for r in rows]
-        cols = [int(c) for c in cols]
-        if len(rows) != len(cols):
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        cols = np.asarray(cols, dtype=np.int64).ravel()
+        if rows.size != cols.size:
             raise ValueError("rows and cols must be the same length")
         changed = 0
         if clear:
             # per-bit so every view is cleared; clear_bit logs itself
             for r, c in zip(rows, cols):
-                changed += self.clear_bit(r, c)
+                changed += self.clear_bit(int(r), int(c))
             return changed
-        if self.options.type in (FieldType.MUTEX, FieldType.BOOL):
-            # Per-bit path so column exclusivity holds; set_bit logs itself
-            # (reference: fragment.go:1787 bulkImportMutex).
+        mutex = self.options.type in (FieldType.MUTEX, FieldType.BOOL)
+        if mutex and rows.size < 256:
+            # Small interactive batches: per-bit keeps fine-grained device
+            # deltas (reference: fragment.go:1787 bulkImportMutex).
             for r, c in zip(rows, cols):
-                changed += self.set_bit(r, c)
+                changed += self.set_bit(int(r), int(c))
             return changed
+        if mutex:
+            # Bulk mutex: later duplicates win per column, then one
+            # vectorized clear-and-set per shard.
+            _, last = np.unique(cols[::-1], return_index=True)
+            idx = cols.size - 1 - last
+            rows, cols = rows[idx], cols[idx]
         self._log("import_bits", self.name, rows, cols)
-        by_shard: Dict[int, tuple] = {}
-        for r, c in zip(rows, cols):
-            shard, pos = divmod(c, SHARD_WIDTH)
-            by_shard.setdefault(shard, ([], []))
-            by_shard[shard][0].append(r)
-            by_shard[shard][1].append(pos)
-        for shard, (rs, ps) in by_shard.items():
-            changed += self.fragment(shard, create=True).set_many(rs, ps)
+        shards = cols >> SHARD_WIDTH_EXP
+        pos = cols & (SHARD_WIDTH - 1)
+        for shard, (r, p) in group_sorted(shards, rows, pos):
+            frag = self.fragment(shard, create=True)
+            changed += frag.set_mutex_many(r, p) if mutex \
+                else frag.set_many(r, p)
         return changed
 
     def write_row_plane(self, shard: int, row: int, plane,

@@ -132,6 +132,20 @@ class _DeltaLog:
         return [p for v, p in self.ops if v > base_version]
 
 
+def group_sorted(keys: np.ndarray, *arrays: np.ndarray):
+    """Stable-sort ``arrays`` by ``keys`` and return a list of
+    ``(key, (slice, ...))`` per distinct key — the shared group-and-slice
+    idiom of every bulk write path (one argsort, contiguous views; a
+    per-unique-key boolean mask would be O(unique * n))."""
+    order = np.argsort(keys, kind="stable")
+    keys_s = keys[order]
+    sorted_arrays = [a[order] for a in arrays]
+    uk, starts = np.unique(keys_s, return_index=True)
+    bounds = np.append(starts[1:], keys_s.size)
+    return [(int(k), tuple(a[lo:hi] for a in sorted_arrays))
+            for k, lo, hi in zip(uk, starts, bounds)]
+
+
 def _grow_rows(planes: np.ndarray, need: int) -> np.ndarray:
     cap = max(_MIN_CAPACITY, planes.shape[0])
     while cap < need:
@@ -212,16 +226,29 @@ class SetFragment:
         if rows.size == 0:
             return 0
         changed = 0
+        groups = group_sorted(rows, cols)
+        # One capacity grow for the whole import, not one per new row
+        # (each grow copies every plane).
+        n_new = sum(1 for r, _ in groups if r not in self.row_index)
+        if n_new:
+            self.planes = _grow_rows(self.planes, len(self.row_ids) + n_new)
+        record_deltas = cols.size <= _DELTA_MAX_COLS
         payloads = []
-        for row in np.unique(rows):
-            s = self._slot(int(row))
-            sel = cols[rows == row]
-            before = int(np.sum(popcount_words(self.planes[s])))
-            self.planes[s] |= bits_to_plane(sel, self.words)
-            changed += int(np.sum(popcount_words(self.planes[s]))) - before
-            payloads.append((int(row), tuple(int(c) for c in sel), ()))
+        for row, (sel,) in groups:
+            s = self._slot(row)
+            sel = np.unique(sel)
+            # changed = bits not already set: O(|sel|) gather, not a
+            # full-plane popcount
+            w = sel >> 5
+            b = (sel & 31).astype(np.uint32)
+            old = (self.planes[s, w] >> b) & np.uint32(1)
+            changed += int(np.count_nonzero(old == 0))
+            # .at, not fancy |=: two cols in one word must both land
+            np.bitwise_or.at(self.planes[s], w, np.uint32(1) << b)
+            if record_deltas:
+                payloads.append((row, tuple(int(c) for c in sel), ()))
         self.version += 1
-        if cols.size > _DELTA_MAX_COLS:
+        if not record_deltas:
             self.deltas.reset(self.version)
         else:
             # new rows are representable (stacked append path)
@@ -233,6 +260,38 @@ class SetFragment:
                     # version), so recording them only burns the fresh
                     # log's budget
                     break
+        if PARANOIA:
+            _paranoia_set(self)
+        return changed
+
+    def set_mutex_many(self, rows: np.ndarray, cols: np.ndarray) -> int:
+        """Bulk mutex/bool import: each column ends up in exactly its new
+        row, cleared from every other (reference: fragment.go:1787
+        bulkImportMutex). Inputs are deduped last-wins per column by the
+        caller. Returns changed bit count (bits newly set in their target
+        row; a column re-asserting its current row changes nothing).
+
+        Bulk-only path — restructures many rows at once, so the delta log
+        resets (full re-stack on next device build); small interactive
+        writes keep using set_bit's fine-grained deltas.
+        """
+        touched = bits_to_plane(cols, self.words)
+        n = len(self.row_ids)
+        # Remember old membership per existing slot, then mass-clear.
+        old = self.planes[:n] & touched[None, :] if n else None
+        if n:
+            self.planes[:n] &= ~touched[None, :]
+        changed = 0
+        for row, (sel,) in group_sorted(rows, cols):
+            s = self._slot(row)
+            plane = bits_to_plane(sel, self.words)
+            if old is not None and s < old.shape[0]:
+                changed += int(np.sum(popcount_words(plane & ~old[s])))
+            else:
+                changed += int(sel.size)
+            self.planes[s] |= plane
+        self.version += 1
+        self.deltas.reset(self.version)
         if PARANOIA:
             _paranoia_set(self)
         return changed
@@ -388,7 +447,10 @@ class BSIFragment:
         self.version += 1
         if PARANOIA:
             _paranoia_bsi(self)
-        if grew:
+        cost = cols.size * (bsiops.OFFSET + self.depth)
+        if grew or cost > _DELTA_MAX_COLS:
+            # over-budget payloads would be dropped by record() anyway —
+            # skip building the per-column tuples on bulk loads
             self.deltas.reset(self.version)
         else:
             # replay fans each column out to every plane row
@@ -396,7 +458,7 @@ class BSIFragment:
                 self.version,
                 ("set", tuple(int(c) for c in cols),
                  tuple(int(v) for v in values)),
-                cost=cols.size * (bsiops.OFFSET + self.depth))
+                cost=cost)
 
     def clear_value(self, col: int) -> bool:
         w, b = divmod(col, BITS_PER_WORD)
