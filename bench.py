@@ -318,35 +318,60 @@ def bench_config5(device: str) -> None:
 # ---------------------------------------------------------------------------
 
 def bench_config3(device: str) -> None:
-    from pilosa_tpu.core import Holder
-    from pilosa_tpu.pql import Executor
-    from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+    from pilosa_tpu.api import API
+    from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 
     rng = np.random.default_rng(3)
-    # lineorder SF-1: ~6M rows (scaled down on the CPU fallback)
+    # lineorder SF-1: ~6M rows (scaled down on the CPU fallback).
+    # SSB-shaped: every lineorder row belongs to exactly ONE year (of 7,
+    # d_year 1992-98) and ONE brand (of 1000, p_brand1 MFGR#xxxx) —
+    # mutex-distributed like the real dimension join keys, NOT 50%-dense
+    # random planes. Loaded through the real import path (mutex bulk
+    # import + brand KEY TRANSLATION + existence tracking), not direct
+    # plane pokes.
     shards, years, brands = max(2, _n(6)), 7, _n(1000)
-    h = Holder()
-    idx = h.create_index("ssb")
-    fy = idx.create_field("year")
-    fb = idx.create_field("brand")
-    ya = {}
-    ba = {}
-    for s in range(shards):
-        yp = _rand_planes(rng, years, WORDS_PER_SHARD)
-        bp = _rand_planes(rng, brands, WORDS_PER_SHARD)
-        ya[s], ba[s] = yp, bp
-        fry = fy.fragment(s, create=True)
-        frb = fb.fragment(s, create=True)
-        for r in range(years):
-            fry.import_row_plane(r, yp[r])
-        for r in range(brands):
-            frb.import_row_plane(r, bp[r])
-    e = Executor(h)
+    n = shards * SHARD_WIDTH
+    year_of = rng.integers(0, years, n)
+    brand_of = rng.integers(0, brands, n)
+    brand_names = np.array([f"MFGR#{1000 + b}" for b in range(brands)])
+    api = API()
+    api.create_index("ssb")
+    api.create_field("ssb", "year", {"type": "mutex"})
+    api.create_field("ssb", "brand", {"type": "mutex", "keys": True})
+    cols = np.arange(n, dtype=np.int64)
+    t0 = time.perf_counter()
+    api.import_bits("ssb", "year", rows=year_of, cols=cols)
+    api.import_bits("ssb", "brand", cols=cols,
+                    row_keys=brand_names[brand_of])
+    load_s = time.perf_counter() - t0
+    print(f"bench: c3 SSB-shaped load {n} rows in {load_s:.1f}s "
+          f"({n / load_s:,.0f} rows/s incl. key translation)",
+          file=sys.stderr)
 
     q = "GroupBy(Rows(year), Rows(brand), limit=100)TopN(brand, n=10)"
-    groups, top = e.execute("ssb", q)
+    groups, top = api.query("ssb", q)
     assert len(groups) == 100 and len(top.pairs) == 10
-    p50 = _p50_ms(lambda: e.execute("ssb", q))
+    # oracle-check a few group counts against the generator
+    fy = api.holder.index("ssb").field("year")
+    fb = api.holder.index("ssb").field("brand")
+    for gc in groups[:3]:
+        y = gc.group[0].row_id
+        name = gc.group[1].row_key or fb.translate.id_to_key[
+            gc.group[1].row_id]
+        b = int(name.split("#")[1]) - 1000
+        want = int(np.sum((year_of == y) & (brand_of == b)))
+        assert gc.count == want, (y, b, gc.count, want)
+    p50 = _p50_ms(lambda: api.query("ssb", q))
+
+    # host planes for the control + kernel runs, FROM the loaded store
+    # (same data both sides)
+    ya = {s: np.stack([fy.fragment(s).row_plane(r) for r in range(years)])
+          for s in range(shards)}
+    brand_ids = sorted(
+        set().union(*[fb.fragment(s).existing_rows() for s in range(shards)]))
+    ba = {s: np.stack([fb.fragment(s).row_plane(r) for r in brand_ids])
+          for s in range(shards)}
+    n_brand_rows = len(brand_ids)
 
     # Kernel-only decomposition: the GroupBy pair-count matmul alone, on
     # device-resident stacked planes (no executor machinery).
@@ -376,7 +401,8 @@ def bench_config3(device: str) -> None:
             def body(i, acc):
                 return acc + pair_counts(a ^ i.astype(jnp.uint32), b)
             return jlax.fori_loop(
-                0, iters, body, jnp.zeros((years, brands), jnp.int32))
+                0, iters, body,
+                jnp.zeros((years, n_brand_rows), jnp.int32))
         return f
 
     def _t(f):
@@ -394,7 +420,7 @@ def bench_config3(device: str) -> None:
                        / (k_iters - 1))
     # MXU work: C[y, b] = sum_c Y[y,c] * B[b,c] over shards*2^20 bit lanes
     bit_cols = shards * WORDS_PER_SHARD * 32
-    flops = 2.0 * years * brands * bit_cols
+    flops = 2.0 * years * n_brand_rows * bit_cols
     tflops = flops / (amortized_ms / 1e3) / 1e12
     # v5e int8 MXU peak (the kernel contracts int8 lanes)
     peak = 394.0 if jax.devices()[0].platform == "tpu" else 0.0
@@ -408,11 +434,11 @@ def bench_config3(device: str) -> None:
         yl = np.unpackbits(
             ya[s].view(np.uint8), bitorder="little").reshape(years, -1)
         bl = np.unpackbits(
-            ba[s].view(np.uint8), bitorder="little").reshape(brands, -1)
+            ba[s].view(np.uint8), bitorder="little").reshape(n_brand_rows, -1)
         np.dot(yl.astype(np.float32), bl.astype(np.float32).T)
         _BYTE_POP[ba[s].view(np.uint8)].sum(axis=-1)
     base_ms = (time.perf_counter() - t0) * 1e3
-    nbytes = (years + brands) * shards * WORDS_PER_SHARD * 4
+    nbytes = (years + n_brand_rows) * shards * WORDS_PER_SHARD * 4
     _emit(f"c3_groupby_topk_p50_ssb_sf1_{shards}shards_{years}x{brands}"
           f"{SCALED} ({device})", p50, "ms", base_ms / p50,
           hbm_bytes=nbytes, gbps=nbytes / p50 / 1e6,
@@ -537,8 +563,11 @@ def orchestrate() -> int:
             else:
                 probe_failures += 1
         env = dict(os.environ, PILOSA_BENCH_CHILD=name, JAX_PLATFORMS="cpu")
+        # per-config bound, NOT the whole remaining budget: one wedged
+        # CPU child must not starve every later config
         rc, why = _run_child(
-            name, env, max(90.0, deadline - time.monotonic()))
+            name, env,
+            max(90.0, min(share, deadline - time.monotonic())))
         if rc != 0:
             print(f"bench: config {name} CPU child "
                   f"{why or f'failed (rc={rc})'}", file=sys.stderr)

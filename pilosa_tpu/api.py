@@ -187,18 +187,18 @@ class API:
         if fld.options.type.is_bsi:
             raise ValueError(
                 f"field {field!r} is int-like; use import_values")
+        from pilosa_tpu.core.translate import bulk_translate_ids
         if row_keys is not None:
-            m = fld.translate.create_keys(row_keys)
-            rows = [m[k] for k in row_keys]
+            rows = bulk_translate_ids(fld.translate, row_keys)
         if col_keys is not None:
-            m = idx.translate.create_keys(col_keys)
-            cols = [m[k] for k in col_keys]
+            cols = bulk_translate_ids(idx.translate, col_keys)
         if len(rows) != len(cols):
             raise ValueError("rows and cols must be the same length")
         with self.txf.qcx():
             changed = fld.import_bits(rows, cols, clear=clear)
             if not clear and idx.options.track_existence:
-                idx.field("_exists").import_bits([0] * len(cols), cols)
+                idx.field("_exists").import_bits(
+                    np.zeros(len(cols), dtype=np.int64), cols)
         M.REGISTRY.count(M.METRIC_CLEARED if clear else M.METRIC_IMPORTED,
                          len(cols))
         self._update_shard_gauge(idx)
@@ -216,15 +216,16 @@ class API:
         if not fld.options.type.is_bsi:
             raise ValueError(f"field {field!r} is not an int-like field")
         if col_keys is not None:
-            m = idx.translate.create_keys(col_keys)
-            cols = [m[k] for k in col_keys]
+            from pilosa_tpu.core.translate import bulk_translate_ids
+            cols = bulk_translate_ids(idx.translate, col_keys)
         if len(cols) != len(values):
             raise ValueError("cols and values must be the same length")
+        cols = np.asarray(cols, dtype=np.int64)
         with self.txf.qcx():
-            fld.set_values([int(c) for c in cols], values)
+            fld.set_values(cols, values)
             if idx.options.track_existence:
                 idx.field("_exists").import_bits(
-                    [0] * len(cols), [int(c) for c in cols])
+                    np.zeros(len(cols), dtype=np.int64), cols)
         M.REGISTRY.count(M.METRIC_IMPORTED, len(cols))
         self._update_shard_gauge(idx)
         return len(cols)

@@ -140,6 +140,21 @@ class InternalClient:
                          {"ids": list(ids)})
         return {int(k): v for k, v in out["keys"].items()}
 
+    def replicate_translate(self, node, index: str, field: Optional[str],
+                            entries: List) -> None:
+        """Push newly created (key, id) entries to a replica (reference:
+        translate.go EntryReader / http_translator.go sync stream)."""
+        self._post(node, "/internal/translate/replicate",
+                   {"index": index, "field": field,
+                    "entries": [[k, int(i)] for k, i in entries]})
+
+    # -- SQL subtree fanout (reference: /sql-exec-graph,
+    #    http_handler.go:538 + sql3/planner/wireprotocol.go) --------------
+
+    def sql_subtree(self, node, spec: dict, shards: Sequence[int]) -> dict:
+        return self._post(node, "/internal/sql/subtree",
+                          {"spec": spec, "shards": list(shards)})
+
     # -- control plane -----------------------------------------------------
 
     def send_message(self, node, msg: dict) -> None:

@@ -127,6 +127,182 @@ class StaticDisCo(DisCo):
             self._checked[node_id] = time.monotonic()
 
 
+class LeaseDisCo(DisCo):
+    """Consensus-backed membership over a shared directory: TTL leases +
+    member registry, the minimal analog of the reference's embedded-etcd
+    heartbeats (etcd/embed.go:458 startHeartbeatAndWatcher, lease TTL
+    keepalive) with cluster state derived exactly like disco/disco.go:53
+    (via ClusterSnapshot.cluster_state).
+
+    Layout under ``root`` (a shared filesystem in multi-host deployments,
+    the same substrate the DAX writelogger/snapshotter use):
+
+        members/<id>.json   — {"id", "uri"}; written atomically on join,
+                              removed on leave() — the etcd member registry
+        leases/<id>         — heartbeat file, rewritten every
+                              ``heartbeat_interval`` with the holder's
+                              wall-clock; a node is live iff its lease
+                              timestamp is within ``ttl`` seconds
+
+    Joining nodes appear to every peer on its next nodes() read and
+    leaving/expired nodes disappear — dynamic membership without restart,
+    unlike StaticDisCo's fixed list. Atomicity is per-file
+    (tmp + os.replace); there is no multi-key transaction, which matches
+    what membership needs (each node only writes its own two files).
+    Timestamps compare across hosts, so shared-FS deployments need NTP at
+    ttl/2 accuracy — the same assumption etcd's lease TTLs make of its
+    own server clock.
+    """
+
+    def __init__(self, root: str, ttl: float = 10.0,
+                 heartbeat_interval: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        import os
+
+        self.root = root
+        self.ttl = ttl
+        self.heartbeat_interval = heartbeat_interval or max(0.5, ttl / 3)
+        self._clock = clock
+        self._os = os
+        self._members_dir = os.path.join(root, "members")
+        self._leases_dir = os.path.join(root, "leases")
+        os.makedirs(self._members_dir, exist_ok=True)
+        os.makedirs(self._leases_dir, exist_ok=True)
+        self._self_id: Optional[str] = None
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        # executor-observed failures (connection refused) force a node
+        # dead until its NEXT heartbeat, like the reference's down-node
+        # confirmation loop (cluster.go:23)
+        self._forced_down: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # -- join / leave / heartbeat -----------------------------------------
+
+    def _write_atomic(self, path: str, data: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(data)
+        self._os.replace(tmp, path)
+
+    def register(self, node: Node) -> None:
+        """Join: publish the member record, take the lease, start the
+        keepalive thread (reference: etcd member add + lease grant)."""
+        import json
+
+        self._self_id = node.id
+        self._write_atomic(
+            self._os.path.join(self._members_dir, f"{node.id}.json"),
+            json.dumps({"id": node.id, "uri": node.uri}))
+        self.heartbeat()
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return  # re-register (e.g. uri update): keepalive already runs
+        self._hb_stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._keepalive, name=f"lease-hb-{node.id}", daemon=True)
+        self._hb_thread.start()
+
+    def leave(self) -> None:
+        """Graceful departure: stop the keepalive, drop lease + member
+        record so peers see the change immediately (etcd member remove)."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        if self._self_id:
+            for p in (self._os.path.join(self._leases_dir, self._self_id),
+                      self._os.path.join(self._members_dir,
+                                         f"{self._self_id}.json")):
+                try:
+                    self._os.remove(p)
+                except FileNotFoundError:
+                    pass
+
+    def suspend(self) -> None:
+        """Simulate a crash (tests/harness): stop the keepalive and drop
+        the lease so peers see the node dead immediately; the member
+        record stays (lease expired != member removed). register()
+        resumes."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        if self._self_id:
+            try:
+                self._os.remove(
+                    self._os.path.join(self._leases_dir, self._self_id))
+            except FileNotFoundError:
+                pass
+
+    def heartbeat(self) -> None:
+        if self._self_id:
+            self._write_atomic(
+                self._os.path.join(self._leases_dir, self._self_id),
+                repr(self._clock()))
+
+    def _keepalive(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                self.heartbeat()
+            except OSError:
+                pass  # shared FS hiccup: retry next tick; lease expires
+                # naturally if it persists
+
+    # -- membership reads ---------------------------------------------------
+
+    def nodes(self) -> List[Node]:
+        import json
+
+        out = []
+        for name in sorted(self._os.listdir(self._members_dir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(self._os.path.join(self._members_dir, name)) as f:
+                    d = json.load(f)
+                out.append(Node(id=d["id"], uri=d.get("uri", "")))
+            except (OSError, ValueError, KeyError):
+                continue  # torn write of a concurrent join: next read
+        return out
+
+    def _lease_time(self, node_id: str) -> float:
+        try:
+            with open(self._os.path.join(self._leases_dir, node_id)) as f:
+                return float(f.read().strip() or 0.0)
+        except (OSError, ValueError):
+            return 0.0
+
+    def live_ids(self) -> List[str]:
+        now = self._clock()
+        out = []
+        with self._lock:
+            forced = dict(self._forced_down)
+        for n in self.nodes():
+            t = self._lease_time(n.id)
+            if now - t > self.ttl:
+                continue  # lease expired
+            if n.id in forced and t <= forced[n.id]:
+                continue  # transport said dead; needs a FRESH heartbeat
+            out.append(n.id)
+        return out
+
+    def is_live(self, node_id: str) -> bool:
+        return node_id in self.live_ids()
+
+    # -- executor failure signals ------------------------------------------
+
+    def mark_down(self, node_id: str) -> None:
+        """Transport-level failure: disbelieve the current lease until
+        the node heartbeats again (a live-but-unreachable peer should not
+        keep receiving fan-out)."""
+        with self._lock:
+            self._forced_down[node_id] = self._lease_time(node_id)
+
+    def mark_up(self, node_id: str) -> None:
+        with self._lock:
+            self._forced_down.pop(node_id, None)
+
+
 class SingleNodeDisCo(DisCo):
     """The degenerate one-node cluster (default for embedded use)."""
 

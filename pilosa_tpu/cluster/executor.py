@@ -51,7 +51,8 @@ class ClusterExecutor:
         self._on_node_down = on_node_down or (lambda _id: None)
         self._live_fn = live_fn
         self.local = Executor(holder, remote=True)
-        self.translator = ClusterTranslator(node_id, holder, client, snapshot_fn)
+        self.translator = ClusterTranslator(node_id, holder, client,
+                                            snapshot_fn, live_fn=live_fn)
 
     # -- public entry ------------------------------------------------------
 
@@ -95,10 +96,15 @@ class ClusterExecutor:
             by_node.setdefault(n.id, []).append(s)
         return by_node
 
-    def _map_shards(self, idx, call: Call,
-                    shards: Sequence[int]) -> List[Any]:
-        """Run `call` over the shards wherever they live; returns per-node
-        partial results (untranslated, untruncated)."""
+    def _fan_shards(self, index: str, shards: Sequence[int],
+                    run_local, run_remote) -> List[Any]:
+        """The shared fan-out + replica-failover loop: group shards by
+        primary owner, run the local group on this thread while remote
+        groups run concurrently (latency = max, not sum — the reference's
+        mapper goroutines, executor.go:6579), and re-target failed
+        nodes' shards at the next replica rank (executor.go:6500).
+        ``run_local(shards)`` / ``run_remote(node, shards)`` produce one
+        partial each; used by the PQL map/reduce AND SQL subtree fanout."""
         snap = self._snapshot_fn()
         nodes = {n.id: n for n in snap.nodes}
         # Seed with membership's view of dead peers (etcd heartbeats in
@@ -107,33 +113,20 @@ class ClusterExecutor:
                           if self._live_fn is not None else set())
         pending = list(shards)
         parts: List[Any] = []
-        pql = call.to_pql()
-
-        def run_remote(node_id: str, node_shards: List[int]):
-            wire = self.client.query_node(
-                nodes[node_id], idx.name, pql, node_shards)
-            return R.result_from_wire(wire[0])
-
         for _attempt in range(max(1, snap.replica_n)):
-            by_node = self._assign(snap, idx.name, pending, dead)
+            by_node = self._assign(snap, index, pending, dead)
             failed: List[int] = []
             remote = {nid: s for nid, s in by_node.items()
                       if nid != self.node_id}
-            # Remote groups run concurrently (latency = max, not sum —
-            # the reference's mapper goroutines, executor.go:6579); the
-            # local group computes on this thread meanwhile.
             with ThreadPoolExecutor(max_workers=max(1, len(remote))) as pool:
-                futs = {nid: pool.submit(run_remote, nid, s)
+                futs = {nid: pool.submit(run_remote, nodes[nid], s)
                         for nid, s in remote.items()}
                 if self.node_id in by_node:
-                    parts.append(self.local.execute(
-                        idx.name, Query([call]),
-                        shards=by_node[self.node_id])[0])
+                    parts.append(run_local(by_node[self.node_id]))
                 for nid, fut in futs.items():
                     try:
                         parts.append(fut.result())
                     except NodeDownError:
-                        # Replica failover (reference: executor.go:6500).
                         dead.add(nid)
                         self._on_node_down(nid)
                         failed.extend(remote[nid])
@@ -142,6 +135,47 @@ class ClusterExecutor:
             pending = failed
         raise NodeDownError(
             f"shards {pending} unreachable on all replicas")
+
+    def _map_shards(self, idx, call: Call,
+                    shards: Sequence[int]) -> List[Any]:
+        """Run `call` over the shards wherever they live; returns per-node
+        partial results (untranslated, untruncated)."""
+        pql = call.to_pql()
+        return self._fan_shards(
+            idx.name, shards,
+            lambda s: self.local.execute(idx.name, Query([call]),
+                                         shards=s)[0],
+            lambda node, s: R.result_from_wire(
+                self.client.query_node(node, idx.name, pql, s)[0]))
+
+    # -- SQL subtree fanout (reference: executionplanner.go:212-338) -------
+
+    def sql_subtree(self, spec: dict):
+        """Fan a serialized SQL subtree out to shard owners; returns one
+        node-partial dict per group, with the same primary->replica
+        failover as the PQL map/reduce (shared _fan_shards loop). The
+        node API reference is set by the ClusterNode wrapper
+        (``_node_api``); the subtree executes against each owner's local
+        shards only."""
+        from pilosa_tpu.obs import metrics as M
+        from pilosa_tpu.sql.fanout import execute_subtree
+
+        index = spec["index"]
+        shards = sorted(self._shards_fn(index)) or [0]
+        api = getattr(self, "_node_api", None)
+
+        def run_local(node_shards):
+            if api is None:
+                raise PQLError("sql_subtree needs the node API wrapper")
+            return execute_subtree(api, spec, node_shards)
+
+        def run_remote(node, node_shards):
+            out = self.client.sql_subtree(node, spec, node_shards)
+            M.REGISTRY.count(M.METRIC_SQL_FANOUT_ROWS,
+                             len(out.get("rows", [])))
+            return out
+
+        return self._fan_shards(index, shards, run_local, run_remote)
 
     # -- reads -------------------------------------------------------------
 

@@ -20,19 +20,25 @@ from pilosa_tpu.server.http import serve
 
 class LocalCluster:
     def __init__(self, n: int, replica_n: int = 1,
-                 base_path: Optional[str] = None):
-        self.disco = InMemDisCo()
+                 base_path: Optional[str] = None, disco_factory=None):
+        """``disco_factory()`` builds one DisCo per node (e.g. LeaseDisCo
+        instances over a shared root — each node holds its own lease);
+        default is a single InMemDisCo shared by every node."""
+        self.disco = InMemDisCo() if disco_factory is None else None
         self.nodes: List[ClusterNode] = []
         self._servers = []
         for i in range(n):
             path = os.path.join(base_path, f"node{i}") if base_path else None
             if path:
                 os.makedirs(path, exist_ok=True)
-            node = ClusterNode(f"node{i}", "", self.disco, path=path,
+            disco = self.disco if disco_factory is None else disco_factory()
+            node = ClusterNode(f"node{i}", "", disco, path=path,
                                replica_n=replica_n)
             srv, _ = serve(node, port=0, background=True)
             host, port = srv.server_address[:2]
             node.node.uri = f"http://{host}:{port}"
+            if disco_factory is not None and hasattr(disco, "register"):
+                disco.register(node.node)  # re-publish with the real uri
             self.nodes.append(node)
             self._servers.append(srv)
 
@@ -52,7 +58,12 @@ class LocalCluster:
         rather than hangs."""
         self._servers[i].shutdown()
         self._servers[i].server_close()
-        self.disco.down(f"node{i}")
+        if self.disco is not None:
+            self.disco.down(f"node{i}")
+        else:  # per-node disco (LeaseDisCo): stop heartbeating
+            d = self.nodes[i].disco
+            if hasattr(d, "suspend"):
+                d.suspend()
 
     def unpause(self, i: int) -> None:
         node = self.nodes[i]
@@ -60,7 +71,10 @@ class LocalCluster:
         host, port = srv.server_address[:2]
         node.node.uri = f"http://{host}:{port}"
         self._servers[i] = srv
-        self.disco.up(f"node{i}")
+        if self.disco is not None:
+            self.disco.up(f"node{i}")
+        elif hasattr(node.disco, "register"):
+            node.disco.register(node.node)  # resume lease + publish uri
 
     def close(self) -> None:
         for srv in self._servers:
@@ -69,3 +83,12 @@ class LocalCluster:
                 srv.server_close()
             except Exception:
                 pass
+        for node in self.nodes:
+            # stop per-node lease heartbeat threads (LeaseDisCo) so a
+            # closed cluster leaves no writers behind
+            leave = getattr(node.disco, "leave", None)
+            if leave is not None:
+                try:
+                    leave()
+                except Exception:
+                    pass

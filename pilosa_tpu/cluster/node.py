@@ -64,6 +64,9 @@ class ClusterNode:
             self.all_shards, on_node_down=self._mark_down,
             live_fn=lambda: set(self.disco.live_ids()))
         self.executor._after_write = self._announce_shards_all
+        # SQL subtree fanout executes node-locally through the full node
+        # API (translator + local engine), sql/fanout.py
+        self.executor._node_api = self
         # Transaction changes sync to peers so an exclusive transaction
         # on any node excludes cluster-wide (reference: server.go:1082).
         self.api.transactions.on_change = self._sync_transaction

@@ -1,4 +1,5 @@
-"""ClusterTranslator: routes key<->ID traffic to the owning nodes.
+"""ClusterTranslator: routes key<->ID traffic to the owning nodes and
+replicates new entries to their replicas.
 
 Reference: cluster.go:233-887 — the coordinator batches keys per
 key-partition, RPCs each batch to the partition primary, and retries
@@ -6,29 +7,112 @@ on ownership races. Row (field) keys all live on one stable node, the
 partition-0 primary (disco/snapshot.go:137). Locally-owned partitions
 hit the holder's stores directly, so a single-node cluster never pays
 an RPC.
+
+Replication (reference: translate.go EntryReader + TranslationSyncer,
+http_translator.go): every create on an owner pushes the NEW (key, id)
+entries to the partition's replicas over
+/internal/translate/replicate — push-based where the reference's
+replicas pull an entry stream, same contract: a promoted replica serves
+(and extends, with non-conflicting ids) the translation namespace
+without the dead primary. Routing skips dead nodes (the promotion),
+using the same liveness signal as the query fan-out.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
+from pilosa_tpu.cluster.client import NodeDownError, RemoteError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 class ClusterTranslator:
-    def __init__(self, node_id: str, holder, client, snapshot_fn):
+    def __init__(self, node_id: str, holder, client, snapshot_fn,
+                 live_fn=None):
         self.node_id = node_id
         self.holder = holder
         self.client = client
         self._snapshot_fn = snapshot_fn  # () -> ClusterSnapshot
+        self._live_fn = live_fn          # () -> set of live node ids
+        # (node, index, field) -> entries a down replica hasn't seen yet
+        self._outbox: Dict[tuple, List] = {}
+
+    def _first_live(self, owners, live=None):
+        """READ failover: first live owner (reference: reads fail over
+        the owner list, executor.go:6500). CREATES never fail over — new
+        ids are allocated only on the true primary (owners[0]), exactly
+        like the reference's createIndexKeys primary loops
+        (cluster.go:233): a promoted replica allocating ids that the
+        recovered primary never saw would hand one id to two keys.
+        ``live`` lets bulk callers hoist the liveness scan."""
+        if self._live_fn is None:
+            return owners[0] if owners else None
+        if live is None:
+            live = set(self._live_fn())
+        for n in owners:
+            if n.id in live:
+                return n
+        return owners[0] if owners else None
+
+    # -- local create + replica push ---------------------------------------
+
+    def _store(self, index: str, field: Optional[str]):
+        idx = self.holder.index(index)
+        return idx.translate if field is None else idx.field(field).translate
+
+    def create_local(self, index: str, field: Optional[str],
+                     keys: List[str]) -> Dict[str, int]:
+        """Create on this node (as owner) and stream the new entries to
+        the replicas (reference: TranslationSyncer push)."""
+        store = self._store(index, field)
+        out, new = store.create_entries(keys)
+        if new:
+            self._push_entries(index, field, new)
+        return out
+
+    def apply_replicated(self, index: str, field: Optional[str],
+                         entries: Iterable) -> None:
+        self._store(index, field).apply_entries(entries)
+
+    def _push_entries(self, index: str, field: Optional[str],
+                      new: List) -> None:
+        snap = self._snapshot_fn()
+        by_node: Dict[str, List] = {}
+        nodes = {}
+        if field is None:
+            for k, id_ in new:
+                for n in snap.key_nodes(index, k)[1:]:
+                    nodes[n.id] = n
+                    by_node.setdefault(n.id, []).append([k, id_])
+        else:
+            for n in snap.partition_nodes(0)[1:]:
+                nodes[n.id] = n
+                by_node[n.id] = [[k, id_] for k, id_ in new]
+        for nid, entries in by_node.items():
+            if nid == self.node_id:
+                continue
+            # a replica that missed earlier pushes catches up on the next
+            # one (per-node outbox; the reference tolerates a lagging
+            # EntryReader the same way — it replays from its position)
+            pending = self._outbox.pop((nid, index, field), [])
+            payload = pending + entries
+            try:
+                self.client.replicate_translate(
+                    nodes[nid], index, field, payload)
+            except (NodeDownError, RemoteError):
+                self._outbox[(nid, index, field)] = payload
 
     # -- index (record) keys ----------------------------------------------
 
-    def _group_keys_by_node(self, snap, index: str, keys: Iterable[str]):
+    def _group_keys_by_node(self, snap, index: str, keys: Iterable[str],
+                            create: bool):
         by_node: Dict[str, List[str]] = {}
         nodes = {}
+        live = set(self._live_fn()) if self._live_fn is not None else None
         for k in keys:
-            owner = snap.key_nodes(index, k)[0]
+            owners = snap.key_nodes(index, k)
+            # creates pin to the true primary; reads fail over
+            owner = owners[0] if create else self._first_live(owners, live)
             nodes[owner.id] = owner
             by_node.setdefault(owner.id, []).append(k)
         return by_node, nodes
@@ -36,13 +120,14 @@ class ClusterTranslator:
     def index_keys(self, index: str, keys: List[str],
                    create: bool) -> Dict[str, int]:
         snap = self._snapshot_fn()
-        by_node, nodes = self._group_keys_by_node(snap, index, keys)
+        by_node, nodes = self._group_keys_by_node(snap, index, keys, create)
         out: Dict[str, int] = {}
         for node_id, batch in by_node.items():
             if node_id == self.node_id:
-                store = self.holder.index(index).translate
-                out.update(store.create_keys(batch) if create
-                           else store.find_keys(batch))
+                if create:
+                    out.update(self.create_local(index, None, batch))
+                else:
+                    out.update(self._store(index, None).find_keys(batch))
             elif create:
                 out.update(self.client.create_index_keys(
                     nodes[node_id], index, batch))
@@ -57,9 +142,10 @@ class ClusterTranslator:
         snap = self._snapshot_fn()
         by_node: Dict[str, List[int]] = {}
         nodes = {}
+        live = set(self._live_fn()) if self._live_fn is not None else None
         for i in ids:
             p = snap.shard_to_partition(index, i // SHARD_WIDTH)
-            owner = snap.partition_nodes(p)[0]
+            owner = self._first_live(snap.partition_nodes(p), live)
             nodes[owner.id] = owner
             by_node.setdefault(owner.id, []).append(i)
         out: Dict[int, str] = {}
@@ -74,15 +160,22 @@ class ClusterTranslator:
     # -- field (row) keys --------------------------------------------------
 
     def _field_primary(self):
-        return self._snapshot_fn().primary_field_translation_node()
+        snap = self._snapshot_fn()
+        return self._first_live(snap.partition_nodes(0))
 
     def field_keys(self, index: str, field: str, keys: List[str],
                    create: bool) -> Dict[str, int]:
-        primary = self._field_primary()
+        if create:
+            # creates pin to the true primary (no promotion — see
+            # _first_live); fail loudly if it is down
+            owners = self._snapshot_fn().partition_nodes(0)
+            primary = owners[0] if owners else None
+        else:
+            primary = self._field_primary()
         if primary is None or primary.id == self.node_id:
-            store = self.holder.index(index).field(field).translate
-            return (store.create_keys(keys) if create
-                    else store.find_keys(keys))
+            if create:
+                return self.create_local(index, field, keys)
+            return self._store(index, field).find_keys(keys)
         if create:
             return self.client.create_field_keys(primary, index, field, keys)
         return self.client.find_field_keys(primary, index, field, keys)

@@ -190,6 +190,8 @@ class Field:
         return out - self.options.base
 
     def set_values(self, cols: Iterable[int], values: Iterable) -> None:
+        if not isinstance(cols, (list, tuple, np.ndarray)):
+            cols = list(cols)  # generators/iterators per the signature
         cols = np.asarray(cols, dtype=np.int64).ravel()
         # Convert (and validate: min/max bounds raise here) BEFORE logging
         # so a rejected write never poisons the WAL for replay.
@@ -217,6 +219,10 @@ class Field:
         """Bulk (row, col) import with IDs already translated (reference:
         fragment.go:1498 bulkImport; mutex variant :1787). Returns changed
         bit count. The one bulk WAL record replaces per-bit logging."""
+        if not isinstance(rows, (list, tuple, np.ndarray)):
+            rows = list(rows)  # generators/iterators per the signature
+        if not isinstance(cols, (list, tuple, np.ndarray)):
+            cols = list(cols)
         rows = np.asarray(rows, dtype=np.int64).ravel()
         cols = np.asarray(cols, dtype=np.int64).ravel()
         if rows.size != cols.size:

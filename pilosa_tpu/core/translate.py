@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 
 class TranslateStore:
@@ -55,8 +55,14 @@ class TranslateStore:
                 f.write(json.dumps([key, id_]) + "\n")
 
     def create_keys(self, keys: Iterable[str]) -> Dict[str, int]:
-        """Find-or-create IDs (reference: cluster.go:233 createIndexKeys —
-        batched, find-first then allocate misses)."""
+        return self.create_entries(keys)[0]
+
+    def create_entries(self, keys: Iterable[str]
+                       ) -> "Tuple[Dict[str, int], List]":
+        """Find-or-create IDs; also returns the NEWLY allocated
+        (key, id) pairs — the replication stream's payload (reference:
+        cluster.go:233 createIndexKeys + translate.go EntryReader
+        entries)."""
         out: Dict[str, int] = {}
         new: List = []
         with self._lock:
@@ -71,7 +77,25 @@ class TranslateStore:
                 out[k] = id_
             if new:
                 self._append(new)
-        return out
+        return out, new
+
+    def apply_entries(self, entries: Iterable) -> None:
+        """Apply replicated (key, id) pairs from the primary (reference:
+        the follower side of TranslationSyncer/EntryReader,
+        translate.go). Idempotent; advances the allocator past every
+        applied id so a PROMOTED replica allocates non-conflicting ids."""
+        with self._lock:
+            fresh = []
+            for k, id_ in entries:
+                id_ = int(id_)
+                if self.key_to_id.get(k) == id_:
+                    continue
+                self.key_to_id[k] = id_
+                self.id_to_key[id_] = k
+                self._next = max(self._next, id_ + 1)
+                fresh.append((k, id_))
+            if fresh:
+                self._append(fresh)
 
     def find_keys(self, keys: Iterable[str]) -> Dict[str, int]:
         return {k: self.key_to_id[k] for k in keys if k in self.key_to_id}
@@ -169,6 +193,12 @@ class PartitionedTranslateStore:
                 f.write(json.dumps([key, id_]) + "\n")
 
     def create_keys(self, keys: Iterable[str]) -> Dict[str, int]:
+        return self.create_entries(keys)[0]
+
+    def create_entries(self, keys: Iterable[str]
+                       ) -> "Tuple[Dict[str, int], List]":
+        """Find-or-create with the new (key, id) pairs for the
+        replication stream (see TranslateStore.create_entries)."""
         out: Dict[str, int] = {}
         new: List = []
         with self._lock:
@@ -184,7 +214,25 @@ class PartitionedTranslateStore:
                 out[k] = id_
             if new:
                 self._append(new)
-        return out
+        return out, new
+
+    def apply_entries(self, entries: Iterable) -> None:
+        """Follower side of the replication stream (see
+        TranslateStore.apply_entries); advances per-partition max ids so
+        a promoted replica keeps the partitioned-ID invariant."""
+        with self._lock:
+            fresh = []
+            for k, id_ in entries:
+                id_ = int(id_)
+                if self.key_to_id.get(k) == id_:
+                    continue
+                self.key_to_id[k] = id_
+                self.id_to_key[id_] = k
+                p = self.partition(k)
+                self._max_id[p] = max(self._max_id.get(p, 0), id_)
+                fresh.append((k, id_))
+            if fresh:
+                self._append(fresh)
 
     def find_keys(self, keys: Iterable[str]) -> Dict[str, int]:
         return {k: self.key_to_id[k] for k in keys if k in self.key_to_id}
@@ -210,3 +258,18 @@ class PartitionedTranslateStore:
 
     def __len__(self) -> int:
         return len(self.key_to_id)
+
+
+def bulk_translate_ids(store, keys) -> "object":
+    """Vectorized find-or-create: ONE create_keys round on the unique
+    keys, mapped back through a LUT (reference: batch.go:860
+    doTranslation batches unique keys the same way). Returns an
+    ``np.int64`` array aligned with ``keys``."""
+    import numpy as np
+
+    arr = np.asarray(keys)
+    uniq, inverse = np.unique(arr, return_inverse=True)
+    uniq_l = [str(k) for k in uniq.tolist()]
+    m = store.create_keys(uniq_l)
+    lut = np.array([m[k] for k in uniq_l], dtype=np.int64)
+    return lut[inverse]

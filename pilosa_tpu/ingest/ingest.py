@@ -160,17 +160,12 @@ class Ingester:
         return n
 
     @staticmethod
-    def _translate_bulk(store, raw) -> "np.ndarray":
-        """Bulk key->id translation: one create_keys round on the unique
-        keys, mapped back through the inverse index (reference:
-        batch.go:860 doTranslation)."""
-        import numpy as np
+    def _translate_bulk(store, raw):
+        """Bulk key->id translation (reference: batch.go:860
+        doTranslation)."""
+        from pilosa_tpu.core.translate import bulk_translate_ids
 
-        arr = np.asarray([str(k) for k in raw], dtype=object)
-        uniq, inverse = np.unique(arr, return_inverse=True)
-        m = store.create_keys(uniq.tolist())
-        lut = np.array([m[k] for k in uniq], dtype=np.int64)
-        return lut[inverse]
+        return bulk_translate_ids(store, [str(k) for k in raw])
 
     def _flush_auto(self, batch: Batch, pending: list, session: str,
                     offset: int) -> int:

@@ -134,9 +134,13 @@ def coerce_column(raw: Sequence[str], opts: FieldOptions):
             out[valid] = vals
             return out, valid
     if t == FieldType.BOOL:
-        # strip + lower to match _coerce's raw.strip().lower()
-        norm = np.char.lower(np.char.strip(np.asarray(raw, dtype=str)))
-        valid = norm != ""
+        # strip + lower to match _coerce's raw.strip().lower(); but
+        # missing-vs-false must match too: only a truly EMPTY cell is
+        # missing (a whitespace-only cell coerces to False, as in the
+        # per-record path)
+        arr = np.asarray(raw, dtype=str)
+        valid = arr != ""
+        norm = np.char.lower(np.char.strip(arr))
         vals = np.isin(norm, ("1", "true", "t", "yes")).astype(np.int64)
         return vals, (None if valid.all() else valid)
     # keyed set/mutex, timestamps: return raw strings; caller translates

@@ -36,6 +36,9 @@ METRIC_DELETE_DATAFRAME = "delete_dataframe"
 # a stacked tensor could not shard over the engine mesh and fell back to
 # single-device placement (misconfigured mesh loses all parallelism)
 METRIC_MESH_FALLBACK = "mesh_sharding_fallback_total"
+# rows received from peers by SQL subtree fanout (transfer accounting:
+# asserts reduced streams, not whole tables, cross the wire)
+METRIC_SQL_FANOUT_ROWS = "sql_fanout_rows_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -190,19 +190,39 @@ ROUTE_LEVELS: Dict[str, tuple] = {
 }
 
 
+def _auth_cookies(headers) -> "tuple":
+    """(access, refresh) from the request's cookies (reference cookie
+    names authenticate.go:33-36)."""
+    from http.cookies import SimpleCookie
+
+    jar = SimpleCookie()
+    try:
+        jar.load(headers.get("Cookie") or "")
+    except Exception:
+        return "", ""
+    get = lambda k: jar[k].value if k in jar else ""  # noqa: E731
+    return get("molecula-chip"), get("refresh-molecula-chip")
+
+
 class Auth:
     """Bound to the HTTP handler; authenticates a request and authorizes
     it against the route's level (reference: http_handler.go chkAuthZ)."""
 
     def __init__(self, secret: str, permissions: Permissions,
-                 allowed_networks: Optional[List[str]] = None):
+                 allowed_networks: Optional[List[str]] = None,
+                 oidc=None):
         self.secret = secret
         self.permissions = permissions
         self.networks = [ipaddress.ip_network(n)
                          for n in (allowed_networks or [])]
+        #: optional server.oidc.OIDCAuth — enables the IdP cookie flow
+        self.oidc = oidc
 
     def authenticate(self, headers, client_ip: str) -> dict:
-        """Returns {"groups": [...], "admin_net": bool}."""
+        """Returns {"groups": [...], "admin_net": bool}; with OIDC
+        configured, cookie-bearing requests resolve groups through the
+        IdP (reference: authenticate.go:174 + getGroups cache) and may
+        carry rotated tokens in ``oidc`` for the handler to re-set."""
         try:
             ip = ipaddress.ip_address(client_ip)
             for net in self.networks:
@@ -213,10 +233,17 @@ class Auth:
         except ValueError:
             pass
         authz = headers.get("Authorization") or ""
-        if not authz.startswith("Bearer "):
-            raise AuthError(401, "missing Bearer token")
-        claims = validate_token(self.secret, authz[len("Bearer "):])
-        return {"groups": list(claims.get("groups", [])), "admin_net": False}
+        if authz.startswith("Bearer "):
+            claims = validate_token(self.secret, authz[len("Bearer "):])
+            return {"groups": list(claims.get("groups", [])),
+                    "admin_net": False}
+        if self.oidc is not None:
+            access, refresh = _auth_cookies(headers)
+            if access:
+                info = self.oidc.authenticate(access, refresh)
+                return {"groups": info["groups"], "admin_net": False,
+                        "oidc": info}
+        raise AuthError(401, "missing Bearer token")
 
     def authorize(self, ctx: dict, level_name: str,
                   index: Optional[str]) -> None:

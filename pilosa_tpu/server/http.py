@@ -36,6 +36,9 @@ _ROUTES = [
     ("POST", re.compile(r"^/internal/index/([^/]+)/query$"),
      "post_internal_query"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
+    # serialized SQL subtree execution (reference: /sql-exec-graph,
+    # http_handler.go:538)
+    ("POST", re.compile(r"^/internal/sql/subtree$"), "post_sql_subtree"),
     ("POST", re.compile(r"^/internal/translate/index/([^/]+)/keys/(create|find)$"),
      "post_translate_index_keys"),
     ("POST", re.compile(r"^/internal/translate/index/([^/]+)/ids$"),
@@ -43,6 +46,8 @@ _ROUTES = [
     ("POST", re.compile(
         r"^/internal/translate/field/([^/]+)/([^/]+)/keys/(create|find)$"),
      "post_translate_field_keys"),
+    ("POST", re.compile(r"^/internal/translate/replicate$"),
+     "post_translate_replicate"),
     ("POST", re.compile(r"^/internal/translate/field/([^/]+)/([^/]+)/ids$"),
      "post_translate_field_ids"),
     ("POST", re.compile(r"^/index/([^/]+)/query$"), "post_query"),
@@ -75,6 +80,11 @@ _ROUTES = [
     # profiling (reference: /debug/pprof http_handler.go:493; per-query
     # CPU profiles :1301 DoPerQueryProfiling — ours via ?profile=true)
     ("GET", re.compile(r"^/debug/pprof$"), "get_pprof"),
+    # resource accounting (reference: http_handler.go:557-559
+    # /internal/mem-usage, /disk-usage)
+    ("GET", re.compile(r"^/internal/mem-usage$"), "get_mem_usage"),
+    ("GET", re.compile(r"^/disk-usage$"), "get_disk_usage"),
+    ("GET", re.compile(r"^/disk-usage/([^/]+)$"), "get_disk_usage"),
     # backup/restore/chksum (reference: ctl/backup.go internal endpoints)
     ("GET", re.compile(r"^/internal/backup\.tar$"), "get_backup_tar"),
     ("POST", re.compile(r"^/internal/restore$"), "post_restore"),
@@ -96,7 +106,25 @@ _ROUTES = [
     ("POST", re.compile(r"^/transaction/([^/]+)/finish$"),
      "post_transaction_finish"),
     ("GET", re.compile(r"^/transactions$"), "get_transactions"),
+    # OIDC login flow (reference: authn/authenticate.go:251-300
+    # Login/Logout/Redirect handlers)
+    ("GET", re.compile(r"^/login$"), "get_login"),
+    ("GET", re.compile(r"^/redirect$"), "get_redirect"),
+    ("GET", re.compile(r"^/logout$"), "get_logout"),
 ]
+
+# The login flow itself must be reachable without credentials.
+_AUTH_EXEMPT = {"get_login", "get_redirect", "get_logout"}
+
+
+def _token_cookies(access: str, refresh: str, expire: bool = False):
+    """Set-Cookie headers for the token pair (reference:
+    authenticate.go:346 SetCookie; names :33-36)."""
+    tail = "; Path=/; HttpOnly; SameSite=Strict"
+    if expire:
+        tail += "; Expires=Thu, 01 Jan 1970 00:00:00 GMT"
+    return [f"molecula-chip={access}{tail}",
+            f"refresh-molecula-chip={refresh}{tail}"]
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -133,8 +161,21 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        self._emit_cookies()
         self.end_headers()
         self.wfile.write(data)
+
+    def _emit_cookies(self) -> None:
+        for header in getattr(self, "_pending_cookies", ()):
+            self.send_header("Set-Cookie", header)
+        self._pending_cookies = []
+
+    def _redirect(self, location: str) -> None:
+        self.send_response(302)
+        self.send_header("Location", location)
+        self.send_header("Content-Length", "0")
+        self._emit_cookies()
+        self.end_headers()
 
     #: set by serve(auth=...); None = auth disabled
     auth = None
@@ -147,6 +188,13 @@ class Handler(BaseHTTPRequestHandler):
 
         ctx = self.auth.authenticate(self.headers, self.client_address[0])
         self._auth_ctx = ctx
+        info = ctx.get("oidc")
+        if info and info.get("rotated"):
+            # expired access token was refreshed mid-request: rotate the
+            # caller's cookies on this response (authenticate.go:174
+            # "caller's responsibility to inform the user")
+            self._pending_cookies = _token_cookies(
+                info["access"], info["refresh"])
         level, takes_index = ROUTE_LEVELS.get(name, ("admin", False))
         index = match.group(1) if takes_index and match.groups() else None
         self.auth.authorize(ctx, level, index)
@@ -168,7 +216,7 @@ class Handler(BaseHTTPRequestHandler):
             match = pattern.match(self.path.split("?", 1)[0])
             if match:
                 try:
-                    if self.auth is not None:
+                    if self.auth is not None and name not in _AUTH_EXEMPT:
                         self._check_auth(name, match)
                     with REGISTRY.timer(METRIC_HTTP_DURATION,
                                         method=method, route=name):
@@ -588,6 +636,96 @@ class Handler(BaseHTTPRequestHandler):
         self.api.receive_message(self._json_body())
         self._send(200, {"success": True})
 
+    # -- resource accounting (reference: http_handler.go:557-559) ----------
+
+    def get_mem_usage(self):
+        """Process + holder memory accounting (reference:
+        /internal/mem-usage)."""
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        holder_bytes = 0
+        # list() snapshots: concurrent imports mutate these dicts and a
+        # live iteration would intermittently RuntimeError under load
+        for idx in list(self.api.holder.indexes.values()):
+            for fld in list(idx.fields.values()):
+                for frags in list(fld.views.values()):
+                    for frag in list(frags.values()):
+                        holder_bytes += frag.planes.nbytes
+                for frag in list(fld.bsi.values()):
+                    holder_bytes += frag.planes.nbytes
+        self._send(200, {
+            "maxRSSBytes": ru.ru_maxrss * 1024,  # linux reports KiB
+            "holderPlaneBytes": holder_bytes,
+        })
+
+    def get_disk_usage(self, index: str = None):
+        """On-disk footprint of the holder (or one index) — reference:
+        /disk-usage and /disk-usage/{index}."""
+        import os as _os
+
+        root = self.api.holder.path
+        if root is None:
+            self._send(200, {"usage": 0})
+            return
+        if index is not None:
+            self.api.holder.index(index)  # 404 on unknown index
+            root = _os.path.join(root, "indexes", index)
+        total = 0
+        for dirpath, _dirs, files in _os.walk(root):
+            for f in files:
+                try:
+                    total += _os.path.getsize(_os.path.join(dirpath, f))
+                except OSError:
+                    pass
+        self._send(200, {"usage": total})
+
+    # -- OIDC login flow (reference: authn/authenticate.go:251-300) --------
+
+    def _oidc(self):
+        oidc = getattr(self.auth, "oidc", None) if self.auth else None
+        if oidc is None:
+            raise KeyError("OIDC login is not configured")
+        return oidc
+
+    def get_login(self):
+        self._redirect(self._oidc().login_url())
+
+    def get_redirect(self):
+        from urllib.parse import parse_qs, urlparse
+
+        oidc = self._oidc()
+        q = parse_qs(urlparse(self.path).query)
+        code = (q.get("code") or [""])[0]
+        if not code:
+            raise ValueError("missing code")
+        state = (q.get("state") or [""])[0]
+        if not oidc.check_state(state):
+            # unknown/expired state: a code this server's /login did not
+            # initiate must not set session cookies (login CSRF)
+            from pilosa_tpu.server.auth import AuthError
+            raise AuthError(403, "invalid OAuth state")
+        access, refresh = oidc.exchange_code(code)
+        self._pending_cookies = _token_cookies(access, refresh)
+        self._redirect("/")
+
+    def get_logout(self):
+        from pilosa_tpu.server.auth import _auth_cookies
+
+        oidc = self._oidc()
+        access, _ = _auth_cookies(self.headers)
+        oidc.evict(access)  # drop this session's cached groups
+        self._pending_cookies = _token_cookies("", "", expire=True)
+        self._redirect(oidc.logout_url())
+
+    def post_sql_subtree(self):
+        self._node_only()
+        from pilosa_tpu.sql.fanout import execute_subtree
+
+        b = self._json_body()
+        self._send(200, execute_subtree(
+            self.api, self._require(b, "spec"), b.get("shards") or []))
+
     def _translate_store(self, index: str, field: str = None):
         idx = self.api.holder.index(index)
         store = idx.translate if field is None else idx.field(field).translate
@@ -597,10 +735,28 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_translate_index_keys(self, index: str, op: str):
         keys = self._json_body().get("keys") or []
-        store = self._translate_store(index)
-        ids = (store.create_keys(keys) if op == "create"
-               else store.find_keys(keys))
+        tr = getattr(getattr(self.api, "executor", None), "translator", None)
+        if op == "create" and tr is not None:
+            # owner-side create replicates new entries to the partition's
+            # replicas (reference: TranslationSyncer push)
+            ids = tr.create_local(index, None, keys)
+        else:
+            store = self._translate_store(index)
+            ids = (store.create_keys(keys) if op == "create"
+                   else store.find_keys(keys))
         self._send(200, {"ids": ids})
+
+    def post_translate_replicate(self):
+        """Follower side of the translate replication stream (reference:
+        translate.go EntryReader; VERDICT r4 missing #7)."""
+        self._node_only()
+        b = self._json_body()
+        idx = self.api.holder.index(self._require(b, "index"))
+        field = b.get("field")
+        store = idx.translate if field is None \
+            else idx.field(field).translate
+        store.apply_entries(b.get("entries") or [])
+        self._send(200, {"success": True})
 
     def post_translate_index_ids(self, index: str):
         ids = self._json_body().get("ids") or []
@@ -608,9 +764,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_translate_field_keys(self, index: str, field: str, op: str):
         keys = self._json_body().get("keys") or []
-        store = self._translate_store(index, field)
-        ids = (store.create_keys(keys) if op == "create"
-               else store.find_keys(keys))
+        tr = getattr(getattr(self.api, "executor", None), "translator", None)
+        if op == "create" and tr is not None:
+            ids = tr.create_local(index, field, keys)
+        else:
+            store = self._translate_store(index, field)
+            ids = (store.create_keys(keys) if op == "create"
+                   else store.find_keys(keys))
         self._send(200, {"ids": ids})
 
     def post_translate_field_ids(self, index: str, field: str):

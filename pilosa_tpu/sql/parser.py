@@ -477,6 +477,19 @@ class Parser:
             if self.at_op("("):
                 self.next()
                 fname = name.upper()
+                if fname == "CAST":
+                    # CAST(expr AS type) -> FuncCall("CAST", [e, 'TYPE'])
+                    e = self.expr()
+                    self.expect_kw("AS")
+                    typ = self.next().value.upper()
+                    if self.accept_op("("):
+                        args_s = [self.next().value]
+                        while self.accept_op(","):
+                            args_s.append(self.next().value)
+                        self.expect_op(")")
+                        typ += f"({','.join(str(a) for a in args_s)})"
+                    self.expect_op(")")
+                    return ast.FuncCall("CAST", [e, ast.Literal(typ)])
                 distinct = False
                 args: List[ast.Expr] = []
                 if self.at_op("*"):

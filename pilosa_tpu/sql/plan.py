@@ -10,6 +10,7 @@ executor; host ops are plain Python over the (small) result stream.
 
 from __future__ import annotations
 
+import datetime as dt_
 import fnmatch
 import re
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -185,16 +186,349 @@ def _eval_func(f: ast.FuncCall, env: Dict[str, Any]) -> Any:
         if name == "SETCONTAINSALL":
             return probe <= target
         return bool(probe & target)  # CONTAINS(single) == ANY(singleton)
-    args = [eval_expr(a, env) for a in f.args]
-    if name == "UPPER":
-        return None if args[0] is None else str(args[0]).upper()
-    if name == "LOWER":
-        return None if args[0] is None else str(args[0]).lower()
-    if name == "LEN":
-        return None if args[0] is None else len(args[0])
-    if name == "ABS":
-        return None if args[0] is None else abs(args[0])
+    try:
+        if name == "CAST":
+            return _eval_cast(eval_expr(f.args[0], env), f.args[1].value)
+        args = [eval_expr(a, env) for a in f.args]
+        if name == "UPPER":
+            return None if args[0] is None else str(args[0]).upper()
+        if name == "LOWER":
+            return None if args[0] is None else str(args[0]).lower()
+        if name == "LEN":
+            return None if args[0] is None else len(args[0])
+        if name == "ABS":
+            return None if args[0] is None else abs(args[0])
+        if name in _STRING_FUNCS:
+            return _STRING_FUNCS[name](args)
+        if name in _DATE_FUNCS:
+            return _DATE_FUNCS[name](args)
+    except SQLError:
+        raise
+    except (TypeError, ValueError, OverflowError, IndexError) as e:
+        # every bad-argument path (incl. wrong arity -> IndexError)
+        # surfaces as a SQL error, never a bare Python exception (HTTP
+        # would 500 on those)
+        raise SQLError(f"{name.lower()}: {e}")
     raise SQLError(f"unknown function {name}")
+
+
+# -- CAST (reference: sql3 coerceValue + defs_cast.go) -----------------------
+
+def _eval_cast(v, typ: str):
+    base = typ.split("(")[0]
+    if v is None:
+        return None
+    if base in ("INT", "ID"):
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, str):
+            try:
+                return int(v)
+            except ValueError:
+                raise SQLError(f"cannot cast {v!r} to {base}")
+        return int(v)
+    if base == "BOOL":
+        if isinstance(v, str):
+            if v.lower() in ("true", "1"):
+                return True
+            if v.lower() in ("false", "0"):
+                return False
+            raise SQLError(f"cannot cast {v!r} to BOOL")
+        return bool(v)
+    if base == "DECIMAL":
+        # DECIMAL(scale) or DECIMAL(precision, scale): scale is last
+        scale = int(typ[len("DECIMAL("):-1].split(",")[-1]) \
+            if "(" in typ else 0
+        try:
+            return round(float(v), scale)
+        except (TypeError, ValueError):
+            raise SQLError(f"cannot cast {v!r} to DECIMAL")
+    if base in ("STRING", "VARCHAR"):
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, list):
+            raise SQLError("cannot cast set to STRING")
+        return str(v)
+    if base in ("IDSET", "STRINGSET"):
+        items = v if isinstance(v, list) else [v]
+        return [str(x) if base == "STRINGSET" else int(x) for x in items]
+    if base == "TIMESTAMP":
+        # integer epoch seconds -> ISO (reference: cast(1000 as timestamp))
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            ts = dt_.datetime.fromtimestamp(v, tz=dt_.timezone.utc)
+            return ts.isoformat().replace("+00:00", "Z")
+        try:
+            return _iso(_parse_ts(v))  # validate, normalize
+        except ValueError:
+            raise SQLError(f"cannot cast {v!r} to TIMESTAMP")
+    raise SQLError(f"cannot cast to {typ}")
+
+
+# -- string functions (reference: inbuiltfunctionsstring.go;
+#    semantics pinned by defs_string_functions.go) ---------------------------
+
+def _s_reverse(a):
+    return None if a[0] is None else str(a[0])[::-1]
+
+
+def _s_substring(a):
+    if any(x is None for x in a):
+        return None
+    s, start = str(a[0]), int(a[1])
+    if start < 0 or start >= len(s):
+        raise SQLError(f"value {start} out of range")
+    end = len(s)
+    if len(a) > 2:
+        end = start + int(a[2])
+    if end < start or end > len(s):
+        raise SQLError(f"value {end} out of range")
+    return s[start:end]
+
+
+def _s_replaceall(a):
+    if any(x is None for x in a):
+        return None
+    return str(a[0]).replace(str(a[1]), str(a[2]))
+
+
+def _s_charindex(a):
+    if any(x is None for x in a):
+        return None
+    sub, s = str(a[0]), str(a[1])
+    pos = int(a[2]) if len(a) > 2 else 0
+    if pos < 0 or pos > len(s):
+        return None
+    return s.find(sub, pos)
+
+
+def _s_trim(a, how="both"):
+    if a[0] is None:
+        return None
+    s = str(a[0])
+    return {"both": s.strip, "l": s.lstrip, "r": s.rstrip}[how]()
+
+
+def _s_space(a):
+    if a[0] is None:
+        return None
+    n = int(a[0])
+    if n < 0:
+        raise SQLError(f"value {n} out of range")
+    return " " * n
+
+
+def _s_str(a):
+    """SQL-Server-style STR(num[, length[, decimals]]): right-justified
+    in ``length`` (default 10), all '*' when it does not fit."""
+    if a[0] is None:
+        return None
+    length = int(a[1]) if len(a) > 1 else 10
+    decimals = int(a[2]) if len(a) > 2 else 0
+    v = a[0]
+    text = f"{v:.{decimals}f}" if decimals > 0 else str(int(round(float(v))))
+    if len(text) > length:
+        return "*" * length
+    return text.rjust(length)
+
+
+def _s_ascii(a):
+    if a[0] is None:
+        return None
+    s = str(a[0])
+    if len(s) != 1:
+        raise SQLError("ascii() requires a single character")
+    return ord(s)
+
+
+def _s_char(a):
+    if a[0] is None:
+        return None
+    return chr(int(a[0]))
+
+
+def _s_format(a):
+    """Go-verb format (%s/%d/%t/%f...; reference EvaluateFormat)."""
+    if a[0] is None:
+        return None
+    fmt = str(a[0])
+    out, ai = [], 1
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%" and i + 1 < len(fmt):
+            verb = fmt[i + 1]
+            i += 2
+            if verb == "%":
+                out.append("%")
+                continue
+            if ai >= len(a):
+                raise SQLError("format: missing argument")
+            v = a[ai]
+            ai += 1
+            try:
+                if verb == "t":
+                    out.append("true" if v else "false")
+                elif verb == "d":
+                    out.append(str(int(v)))
+                elif verb == "f":
+                    out.append(str(float(v)))
+                else:
+                    out.append(str(v))
+            except (TypeError, ValueError):
+                raise SQLError(
+                    f"format: %{verb} needs a numeric argument, got {v!r}")
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+_STRING_FUNCS = {
+    "REVERSE": _s_reverse,
+    "SUBSTRING": _s_substring,
+    "REPLACEALL": _s_replaceall,
+    "CHARINDEX": _s_charindex,
+    "TRIM": lambda a: _s_trim(a, "both"),
+    "LTRIM": lambda a: _s_trim(a, "l"),
+    "RTRIM": lambda a: _s_trim(a, "r"),
+    "SPACE": _s_space,
+    "STR": _s_str,
+    "ASCII": _s_ascii,
+    "CHAR": _s_char,
+    "FORMAT": _s_format,
+}
+
+
+# -- date functions (reference: inbuiltfunctionsdate.go; interval names
+#    YY/YD/M/D/W/WK/HH/MI/S/MS/US/NS) ---------------------------------------
+
+def _parse_ts(v) -> "dt_.datetime":
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return dt_.datetime.fromtimestamp(v, tz=dt_.timezone.utc)
+    t = dt_.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+    return t if t.tzinfo else t.replace(tzinfo=dt_.timezone.utc)
+
+
+def _iso(t: "dt_.datetime") -> str:
+    return t.isoformat().replace("+00:00", "Z")
+
+
+def _d_part(a):
+    if any(x is None for x in a):
+        return None
+    part, t = str(a[0]).upper(), _parse_ts(a[1])
+    if part == "YY":
+        return t.year
+    if part == "YD":
+        return t.timetuple().tm_yday
+    if part == "M":
+        return t.month
+    if part == "D":
+        return t.day
+    if part == "W":
+        return (t.weekday() + 1) % 7  # Go: Sunday=0
+    if part == "WK":
+        return t.isocalendar()[1]
+    if part == "HH":
+        return t.hour
+    if part == "MI":
+        return t.minute
+    if part == "S":
+        return t.second
+    if part == "MS":
+        return t.microsecond // 1000
+    if part == "US":
+        return t.microsecond
+    if part == "NS":
+        return t.microsecond * 1000
+    raise SQLError(f"invalid interval {part!r}")
+
+
+def _d_add(a):
+    if any(x is None for x in a):
+        return None
+    part, n, t = str(a[0]).upper(), int(a[1]), _parse_ts(a[2])
+    if part in ("YY", "M"):
+        # normalize day overflow like Go's time.AddDate (the reference's
+        # engine): Jan 31 + 1 month = Mar 3, Feb 29 + 1 year = Mar 1
+        years, months = (n, 0) if part == "YY" else (0, n)
+        mo = t.month - 1 + months
+        y = t.year + years + mo // 12
+        first = t.replace(year=y, month=mo % 12 + 1, day=1)
+        return _iso(first + dt_.timedelta(days=t.day - 1))
+    delta = {"D": dt_.timedelta(days=n), "HH": dt_.timedelta(hours=n),
+             "MI": dt_.timedelta(minutes=n), "S": dt_.timedelta(seconds=n),
+             "MS": dt_.timedelta(milliseconds=n),
+             "US": dt_.timedelta(microseconds=n),
+             "NS": dt_.timedelta(microseconds=n // 1000)}.get(part)
+    if delta is None:
+        raise SQLError(f"invalid interval {part!r}")
+    return _iso(t + delta)
+
+
+def _d_diff(a):
+    if any(x is None for x in a):
+        return None
+    part = str(a[0]).upper()
+    t1, t2 = _parse_ts(a[1]), _parse_ts(a[2])
+    if part == "YY":
+        return t2.year - t1.year
+    if part == "M":
+        return (t2.year - t1.year) * 12 + (t2.month - t1.month)
+    # exact integer arithmetic from the timedelta's integer fields —
+    # float seconds lose precision past 2^53 for ns/us spans
+    delta = t2 - t1
+    total_us = (delta.days * 86400 + delta.seconds) * 1_000_000 \
+        + delta.microseconds
+    div_us = {"D": 86_400_000_000, "HH": 3_600_000_000,
+              "MI": 60_000_000, "S": 1_000_000, "MS": 1_000, "US": 1}
+    if part == "NS":
+        return total_us * 1000
+    if part not in div_us:
+        raise SQLError(f"invalid interval {part!r}")
+    d = div_us[part]
+    return total_us // d if total_us >= 0 else -((-total_us) // d)
+
+
+def _d_totimestamp(a):
+    """int -> timestamp at a given unit (reference: toTimestamp(val,
+    'ms'|'s'|...))."""
+    if a[0] is None:
+        return None
+    unit = str(a[1]).lower() if len(a) > 1 else "s"
+    per_s = {"s": 1, "ms": 10**3, "us": 10**6, "µs": 10**6, "ns": 10**9}
+    if unit not in per_s:
+        raise SQLError(f"invalid timestamp unit {unit!r}")
+    # exact integer split: float multiplication loses sub-second digits
+    # for large us/ns epochs (same reasoning as _d_diff)
+    sec, frac = divmod(int(a[0]), per_s[unit])
+    us = frac * 10**6 // per_s[unit]
+    t = dt_.datetime.fromtimestamp(sec, tz=dt_.timezone.utc) \
+        + dt_.timedelta(microseconds=us)
+    return _iso(t)
+
+
+def _d_name(a):
+    out = _d_part(a)
+    if out is None:
+        return None
+    part = str(a[0]).upper()
+    t = _parse_ts(a[1])
+    if part == "M":
+        return t.strftime("%B")
+    if part == "W":
+        return t.strftime("%A")
+    return str(out)
+
+
+_DATE_FUNCS = {
+    "DATETIMEPART": _d_part,
+    "DATEPART": _d_part,
+    "DATETIMEADD": _d_add,
+    "DATETIMEDIFF": _d_diff,
+    "DATETIMENAME": _d_name,
+    "TOTIMESTAMP": _d_totimestamp,
+}
 
 
 # -- host operators ----------------------------------------------------------

@@ -444,3 +444,109 @@ class TestGRPCInspect:
         h, r = proto.decode_row_response(
             s.call("Inspect", proto._str_field(1, "dq"))[0])
         assert ("d", "DECIMAL(2)") in h and r == [1, 1.25]
+
+
+class TestOIDC:
+    """OIDC login flow against an in-process fake IdP (reference:
+    authn/authenticate.go:77-426 + idk/fakeidp; VERDICT r4 missing #4).
+    Round-trip: /login redirect -> IdP authorize -> /redirect code
+    exchange -> cookies -> authenticated query; plus token refresh and
+    the group-claims cache."""
+
+    @pytest.fixture()
+    def oidc_server(self):
+        from pilosa_tpu.server.oidc import FakeIdP, OAuthConfig, OIDCAuth
+
+        idp = FakeIdP(groups=[{"id": READ_G, "displayName": "readers"}])
+        base_idp = idp.serve()
+        api = API()
+        api.create_index("t")
+        api.create_field("t", "f", {"type": "set"})
+        cfg = OAuthConfig(
+            auth_url=base_idp + "/authorize",
+            token_url=base_idp + "/token",
+            group_endpoint=base_idp + "/groups",
+            logout_endpoint=base_idp + "/logout",
+            client_id="cid", client_secret="cs")
+        oidc = OIDCAuth(cfg)
+        auth = Auth(SECRET, PERMS, oidc=oidc)
+        srv, _ = serve(api, port=0, background=True, auth=auth)
+        host, port = srv.server_address[:2]
+        cfg.redirect_url = f"http://{host}:{port}/redirect"
+        yield f"http://{host}:{port}", idp, oidc
+        srv.shutdown()
+        srv.server_close()
+        idp.close()
+
+    def _get(self, url, cookies=None, redirect=False):
+        req = urllib.request.Request(url)
+        if cookies:
+            req.add_header("Cookie", cookies)
+        opener = urllib.request.build_opener(_NoRedirect())
+        try:
+            r = opener.open(req)
+            hdrs = r.headers
+            return r.status, hdrs, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers, e.read()
+
+    def test_full_login_round_trip(self, oidc_server):
+        base, idp, oidc = oidc_server
+        # 1. /login redirects to the IdP's authorize endpoint
+        code_, hdrs, _ = self._get(base + "/login")
+        assert code_ == 302 and "/authorize?" in hdrs["Location"]
+        # 2. IdP authorize redirects back with an auth code
+        code_, hdrs, _ = self._get(hdrs["Location"])
+        assert code_ == 302 and "code=" in hdrs["Location"]
+        # 3. /redirect exchanges the code and sets token cookies
+        code_, hdrs, _ = self._get(hdrs["Location"])
+        assert code_ == 302
+        cookies = hdrs.get_all("Set-Cookie") or []
+        pairs = dict(c.split(";", 1)[0].split("=", 1) for c in cookies)
+        assert "molecula-chip" in pairs and "refresh-molecula-chip" in pairs
+        jar = (f"molecula-chip={pairs['molecula-chip']}; "
+               f"refresh-molecula-chip={pairs['refresh-molecula-chip']}")
+        # 4. a cookie-authenticated request passes authz (READ on t)
+        code_, _, body = self._get(base + "/schema", jar)
+        assert code_ == 200, body
+        # no cookies, no bearer -> 401
+        code_, _, _ = self._get(base + "/schema")
+        assert code_ == 401
+
+    def test_group_cache_and_refresh(self, oidc_server):
+        base, idp, oidc = oidc_server
+        access = idp.mint("bob")
+        refresh = "r1"
+        idp.refreshes[refresh] = "bob"
+        jar = f"molecula-chip={access}; refresh-molecula-chip={refresh}"
+        for _ in range(3):
+            code_, _, _ = self._get(base + "/schema", jar)
+            assert code_ == 200
+        assert idp.group_calls == 1  # TTL cache: one IdP groups call
+        # expired access token: the server refreshes and rotates cookies
+        expired = idp.mint("bob", ttl=-10)
+        jar2 = f"molecula-chip={expired}; refresh-molecula-chip={refresh}"
+        code_, hdrs, _ = self._get(base + "/schema", jar2)
+        assert code_ == 200
+        assert any(c.startswith("molecula-chip=")
+                   for c in hdrs.get_all("Set-Cookie") or [])
+        # garbage access token -> 401, not a 500
+        code_, _, _ = self._get(base + "/schema",
+                                "molecula-chip=notajwt")
+        assert code_ == 401
+
+    def test_logout_clears_session(self, oidc_server):
+        base, idp, oidc = oidc_server
+        access = idp.mint("eve")
+        jar = f"molecula-chip={access}"
+        assert self._get(base + "/schema", jar)[0] == 200
+        code_, hdrs, _ = self._get(base + "/logout", jar)
+        assert code_ == 302
+        assert any("Expires=Thu, 01 Jan 1970" in c
+                   for c in hdrs.get_all("Set-Cookie") or [])
+        assert access not in oidc._groups_cache
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *a, **k):
+        return None

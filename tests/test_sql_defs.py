@@ -51,6 +51,20 @@ SETUP = [
     "create table big (_id id, seg id, n int)",
     "insert into big values (5, 1, 2), (1048581, 1, 3), "
     "(2097157, 2, 4), (10, 2, 1)",
+    # defs_cast.go model (cast_int / cast_string source tables)
+    "create table casts (_id id, i1 int, d1 decimal(2), s1 string, "
+    "b1 bool)",
+    "insert into casts values (1, 10, 12.34, '20', true), "
+    "(2, -5, 0.50, 'abc', false)",
+    # defs_date_functions.go model (dttable)
+    "create table dts (_id id, t timestamp, t2 timestamp)",
+    "insert into dts values "
+    "(1, '2023-01-15T10:30:45Z', '2023-03-20T08:00:00Z'), "
+    "(2, '2024-02-29T23:59:59Z', '2024-03-01T00:00:01Z')",
+    # defs_minmaxnegative.go model
+    "create table neg (_id id, n int, d decimal(2))",
+    "insert into neg values (1, -11, -11.50), (2, -22, -0.25), "
+    "(3, 33, 3.75), (4, 0, 0.00)",
 ]
 
 # (name, sql, expected rows, ordered)
@@ -213,6 +227,135 @@ CASES = [
       ["d", 5.99], ["e", None]], True),
     ("join-count", "select count(*) from orders o join users u "
      "on o.userid = u._id", [[6]], False),
+    # -- CAST (defs_cast.go; literal + column forms) -----------------------
+    ("cast-int-int", "select cast(1 as int)", [[1]], False),
+    ("cast-int-bool", "select cast(1 as bool)", [[True]], False),
+    ("cast-zero-bool", "select cast(0 as bool)", [[False]], False),
+    ("cast-int-string", "select cast(1 as string)", [["1"]], False),
+    ("cast-int-id", "select cast(1 as id)", [[1]], False),
+    ("cast-int-timestamp", "select cast(1000 as timestamp)",
+     [["1970-01-01T00:16:40Z"]], False),
+    ("cast-string-int", "select cast('20' as int)", [[20]], False),
+    ("cast-bool-string", "select cast(true as string)", [["true"]], False),
+    ("cast-col-int", "select _id, cast(i1 as int) from casts",
+     [[1, 10], [2, -5]], False),
+    ("cast-col-bool", "select _id, cast(i1 as bool) from casts",
+     [[1, True], [2, True]], False),
+    ("cast-col-string", "select _id, cast(i1 as string) from casts",
+     [[1, "10"], [2, "-5"]], False),
+    ("cast-col-decimal", "select _id, cast(d1 as decimal(1)) from casts",
+     [[1, 12.3], [2, 0.5]], False),
+    ("cast-string-col-int",
+     "select cast(s1 as int) from casts where _id = 1", [[20]], False),
+    # -- string functions (defs_string_functions.go; expected values are
+    #    the reference's) -------------------------------------------------
+    ("str-reverse-empty", "select reverse('')", [[""]], False),
+    ("str-reverse", "select reverse('this')", [["siht"]], False),
+    ("str-reverse-reverse", "select reverse(reverse('this'))",
+     [["this"]], False),
+    ("str-reverse-null", "select reverse(null)", [[None]], False),
+    ("str-substring", "select substring('testing', 1, 3)", [["est"]], False),
+    ("str-substring-tail", "select substring('testing', 4)",
+     [["ing"]], False),
+    ("str-substring-rev", "select substring(reverse('testing'), 3)",
+     [["tset"]], False),
+    ("str-substring-null", "select substring(null, 1, 3)", [[None]], False),
+    ("str-replaceall",
+     "select replaceall('hello database','data','feature')",
+     [["hello featurebase"]], False),
+    ("str-replaceall-null",
+     "select replaceall('hello database',null,'feature')", [[None]], False),
+    ("str-replaceall-nested",
+     "select replaceall(reverse('gnitset'),substring('testing',4),"
+     "upper('ed'))", [["testED"]], False),
+    ("str-charindex", "select charindex('is','this is great')", [[2]], False),
+    ("str-charindex-pos", "select charindex('is','this is great',3)",
+     [[5]], False),
+    ("str-charindex-missing", "select charindex('abc','this is great',3)",
+     [[-1]], False),
+    ("str-charindex-null", "select charindex(null,'this is great',3)",
+     [[None]], False),
+    ("str-trim", "select trim('  this  ')", [["this"]], False),
+    ("str-rtrim", "select rtrim('  this  ')", [["  this"]], False),
+    ("str-ltrim", "select ltrim('  this  ')", [["this  "]], False),
+    ("str-space", "select space(5)", [["     "]], False),
+    ("str-space-zero", "select space(0)", [[""]], False),
+    ("str-space-null", "select space(null)", [[None]], False),
+    ("str-str", "select str(12345)", [["     12345"]], False),
+    ("str-str-len", "select str(12345, 5)", [["12345"]], False),
+    ("str-str-overflow", "select str(12345, 5, 5)", [["*****"]], False),
+    ("str-str-round", "select str(12345.678)", [["     12346"]], False),
+    ("str-ascii", "select ascii('R')", [[82]], False),
+    ("str-char", "select char(82)", [["R"]], False),
+    ("str-format", "select format('this or %s', 'that')",
+     [["this or that"]], False),
+    ("str-format-bool", "select format('is this %t?', true)",
+     [["is this true?"]], False),
+    ("str-format-int", "select format('%d > %d', 11, 9)",
+     [["11 > 9"]], False),
+    ("str-format-noarg", "select format('noArg')", [["noArg"]], False),
+    ("str-upper-col", "select _id, upper(s1) from casts",
+     [[1, "20"], [2, "ABC"]], False),
+    # -- date functions (defs_date_functions.go; YY/YD/M/D/W/WK/HH/MI/S
+    #    interval names) --------------------------------------------------
+    ("dt-part-yy", "select datetimepart('yy', '2023-06-01T11:22:33Z')",
+     [[2023]], False),
+    ("dt-part-m", "select datetimepart('m', '2023-06-01T11:22:33Z')",
+     [[6]], False),
+    ("dt-part-d", "select datetimepart('d', '2023-06-01T11:22:33Z')",
+     [[1]], False),
+    ("dt-part-hh", "select datetimepart('hh', '2023-06-01T11:22:33Z')",
+     [[11]], False),
+    ("dt-part-mi", "select datetimepart('mi', '2023-06-01T11:22:33Z')",
+     [[22]], False),
+    ("dt-part-s", "select datetimepart('s', '2023-06-01T11:22:33Z')",
+     [[33]], False),
+    ("dt-part-yd", "select datetimepart('yd', '2023-02-01T00:00:00Z')",
+     [[32]], False),
+    ("dt-part-col", "select _id, datetimepart('yy', t) from dts",
+     [[1, 2023], [2, 2024]], False),
+    ("dt-add-yy", "select datetimeadd('yy', 2, '2023-11-15T01:02:03Z')",
+     [["2025-11-15T01:02:03Z"]], False),
+    ("dt-add-m-wrap", "select datetimeadd('m', 2, '2023-11-15T00:00:00Z')",
+     [["2024-01-15T00:00:00Z"]], False),
+    ("dt-add-d", "select datetimeadd('d', 10, '2023-12-25T12:00:00Z')",
+     [["2024-01-04T12:00:00Z"]], False),
+    ("dt-add-s-null", "select datetimeadd('s', null, t) from dts where "
+     "_id = 1", [[None]], False),
+    ("dt-diff-d", "select datetimediff('d', '2023-01-01T00:00:00Z', "
+     "'2023-03-01T00:00:00Z')", [[59]], False),
+    ("dt-diff-col", "select _id, datetimediff('d', t, t2) from dts",
+     [[1, 63], [2, 0]], False),
+    ("dt-name-month", "select datetimename('m', '2023-06-01T00:00:00Z')",
+     [["June"]], False),
+    ("dt-totimestamp-ms", "select totimestamp(1000, 'ms')",
+     [["1970-01-01T00:00:01Z"]], False),
+    ("dt-totimestamp-s", "select totimestamp(1000)",
+     [["1970-01-01T00:16:40Z"]], False),
+    # -- percentile (defs_aggregate.go percentile cases) -------------------
+    ("pct-0", "select percentile(n, 0) from agg", [[1]], False),
+    ("pct-50", "select percentile(n, 50) from agg", [[5]], False),
+    ("pct-100", "select percentile(n, 100) from agg", [[9]], False),
+    ("pct-where", "select percentile(n, 50) from agg where seg = 10",
+     [[5]], False),
+    # -- min/max over negatives (defs_minmaxnegative.go) -------------------
+    ("neg-min", "select min(n) from neg", [[-22]], False),
+    ("neg-max", "select max(n) from neg", [[33]], False),
+    ("neg-min-dec", "select min(d) from neg", [[-11.5]], False),
+    ("neg-max-dec", "select max(d) from neg", [[3.75]], False),
+    ("neg-sum", "select sum(n) from neg", [[0]], False),
+    ("neg-where-lt", "select _id from neg where n < 0",
+     [[1], [2]], False),
+    ("neg-between", "select _id from neg where n between -25 and -5",
+     [[1], [2]], False),
+    # -- more null semantics (defs_null.go) --------------------------------
+    ("null-arith", "select 1 + null", [[None]], False),
+    ("null-cast", "select cast(null as int)", [[None]], False),
+    ("null-eq-null", "select count(*) from nulls where a = null",
+     [[0]], False),
+    ("null-isnull-notnull",
+     "select _id from nulls where a is not null and s is not null",
+     [[1]], False),
     # -- multi-shard (cluster distribution) --------------------------------
     ("big-count", "select count(*) from big", [[4]], False),
     ("big-sum", "select sum(n) from big", [[10]], False),
@@ -414,3 +557,41 @@ class TestViews:
             api.sql("create view bad as select nope from base")
         with pytest.raises(Exception):
             api.sql("create view bad2 as select _id from missing_table")
+
+
+class TestFunctionEdges:
+    """Round-5 review findings: SQL function error surfaces and
+    normalization (uncaught ValueErrors must be SQLErrors; month/year
+    adds normalize day overflow like Go's time.AddDate)."""
+
+    @pytest.fixture(scope="class")
+    def api(self):
+        return API()
+
+    def test_datetimeadd_day_overflow_normalizes(self, api):
+        assert api.sql(
+            "select datetimeadd('m', 1, '2023-01-31T00:00:00Z')"
+        ).data == [["2023-03-03T00:00:00Z"]]
+        assert api.sql(
+            "select datetimeadd('yy', 1, '2024-02-29T00:00:00Z')"
+        ).data == [["2025-03-01T00:00:00Z"]]
+
+    def test_cast_errors_are_sql_errors(self, api):
+        from pilosa_tpu.sql.lexer import SQLError
+        for q in ("select cast('abc' as decimal(2))",
+                  "select cast('notadate' as timestamp)",
+                  "select cast('abc' as int)",
+                  "select format('%d', 'x')"):
+            with pytest.raises(SQLError):
+                api.sql(q)
+
+    def test_cast_timestamp_normalizes(self, api):
+        assert api.sql(
+            "select cast('2023-01-15T10:30:45+00:00' as timestamp)"
+        ).data == [["2023-01-15T10:30:45Z"]]
+
+    def test_datetimediff_ns_exact(self, api):
+        got = api.sql(
+            "select datetimediff('ns', '2020-01-01T00:00:00Z', "
+            "'2021-01-01T00:00:00.000001Z')").data[0][0]
+        assert got == 31622400000001000
