@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from pilosa_tpu import native
 from pilosa_tpu.ops import bsi as bsiops
 from pilosa_tpu.ops.bitmap import bits_to_plane
 from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
@@ -237,14 +238,10 @@ class SetFragment:
         for row, (sel,) in groups:
             s = self._slot(row)
             sel = np.unique(sel)
-            # changed = bits not already set: O(|sel|) gather, not a
-            # full-plane popcount
-            w = sel >> 5
-            b = (sel & 31).astype(np.uint32)
-            old = (self.planes[s, w] >> b) & np.uint32(1)
-            changed += int(np.count_nonzero(old == 0))
-            # .at, not fancy |=: two cols in one word must both land
-            np.bitwise_or.at(self.planes[s], w, np.uint32(1) << b)
+            # fused gather+scatter: count bits not already set while
+            # setting them — O(|sel|), no full-plane popcount (native
+            # C++ kernel, numpy fallback)
+            changed += native.scatter_new_bits(self.planes[s], sel)
             if record_deltas:
                 payloads.append((row, tuple(int(c) for c in sel), ()))
         self.version += 1
@@ -286,7 +283,7 @@ class SetFragment:
             s = self._slot(row)
             plane = bits_to_plane(sel, self.words)
             if old is not None and s < old.shape[0]:
-                changed += int(np.sum(popcount_words(plane & ~old[s])))
+                changed += native.popcount(plane & ~old[s])
             else:
                 changed += int(sel.size)
             self.planes[s] |= plane

@@ -1,0 +1,194 @@
+"""ctypes loader for the native host kernels (native/pilosa_native.cpp).
+
+The device path is XLA; this accelerates the HOST half of the runtime —
+bulk-import scatter, changed-bit gather, popcounts, bit materialization
+— the loops the reference runs in compiled Go (roaring/roaring.go:711,
+:2380). The shared object compiles on first use with g++ -O3 into a
+cache directory and is memoized; every entry point has a numpy fallback
+so the engine works without a toolchain (tests exercise both).
+
+Set PILOSA_TPU_NO_NATIVE=1 to force the numpy fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "pilosa_native.cpp")
+
+
+def _build(src: str) -> Optional[str]:
+    """Compile to a per-user cache keyed by source mtime; returns the
+    .so path or None when no toolchain / compile failure."""
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"pilosa_tpu_native_{os.getuid()}")
+    os.makedirs(cache, exist_ok=True)
+    tag = int(os.stat(src).st_mtime)
+    so = os.path.join(cache, f"pilosa_native_{tag}.so")
+    if os.path.exists(so):
+        return so
+    tmp = so + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", tmp, src]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            # -march=native can be unsupported in odd sandboxes
+            cmd.remove("-march=native")
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            print("pilosa_tpu.native: build failed: "
+                  + r.stderr.decode(errors="replace")[-300:],
+                  file=sys.stderr)
+            return None
+        os.replace(tmp, so)  # atomic publish for concurrent builders
+        return so
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+            return None
+        src = _source_path()
+        if not os.path.exists(src):
+            return None
+        so = _build(src)
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.scatter_bits.argtypes = [u32p, i64p, ctypes.c_size_t]
+        lib.gather_bits.argtypes = [u32p, i64p, u8p, ctypes.c_size_t]
+        lib.scatter_new_bits.argtypes = [u32p, i64p, ctypes.c_size_t]
+        lib.scatter_new_bits.restype = ctypes.c_int64
+        lib.popcount_words.argtypes = [u32p, ctypes.c_size_t]
+        lib.popcount_words.restype = ctypes.c_int64
+        lib.and_popcount.argtypes = [u32p, u32p, ctypes.c_size_t]
+        lib.and_popcount.restype = ctypes.c_int64
+        lib.plane_to_bits.argtypes = [u32p, ctypes.c_size_t, u64p]
+        lib.plane_to_bits.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _check_bounds(plane: np.ndarray, cols: np.ndarray) -> None:
+    """The C kernels write unchecked; validate here so a bad col raises
+    IndexError (as numpy fancy indexing used to) instead of corrupting
+    the heap."""
+    if cols.size and (int(cols.min()) < 0
+                      or (int(cols.max()) >> 5) >= plane.size):
+        raise IndexError(
+            f"column out of range for plane of {plane.size} words")
+
+
+def scatter_bits(plane: np.ndarray, cols: np.ndarray) -> None:
+    """plane |= bits at cols (duplicate-safe, the ufunc.at replacement)."""
+    lib = _load()
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    _check_bounds(plane, cols)
+    if lib is None:
+        np.bitwise_or.at(plane, cols >> 5,
+                         np.uint32(1) << (cols & 31).astype(np.uint32))
+        return
+    lib.scatter_bits(_u32(plane), _i64(cols), cols.size)
+
+
+def scatter_new_bits(plane: np.ndarray, cols: np.ndarray) -> int:
+    """Set bits at cols; returns how many were NOT already set (the
+    fused gather+scatter of bulk imports)."""
+    lib = _load()
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    _check_bounds(plane, cols)
+    if lib is None:
+        # dedupe first: a duplicated column is one bit, not two changes
+        # (the native kernel's sequential pass gets this for free)
+        cols = np.unique(cols)
+        w = cols >> 5
+        b = (cols & 31).astype(np.uint32)
+        old = (plane[w] >> b) & np.uint32(1)
+        changed = int(np.count_nonzero(old == 0))
+        np.bitwise_or.at(plane, w, np.uint32(1) << b)
+        return changed
+    return int(lib.scatter_new_bits(_u32(plane), _i64(cols), cols.size))
+
+
+def _as_words(x: np.ndarray) -> np.ndarray:
+    """Reinterpret (never value-cast) any array as uint32 words, zero-
+    padding the byte tail — a cast from uint64 would drop high bits."""
+    b = np.ascontiguousarray(x).ravel().view(np.uint8)
+    if b.size % 4:
+        b = np.concatenate([b, np.zeros(4 - b.size % 4, dtype=np.uint8)])
+    return b.view(np.uint32)
+
+
+def popcount(plane: np.ndarray) -> int:
+    lib = _load()
+    words = _as_words(plane)
+    if lib is None:
+        if hasattr(np, "bitwise_count"):  # numpy>=2: no 8x unpack blowup
+            return int(np.bitwise_count(words).sum())
+        return int(np.unpackbits(words.view(np.uint8)).sum())
+    return int(lib.popcount_words(_u32(words), words.size))
+
+
+def and_popcount(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is None:
+        return popcount(np.asarray(a) & np.asarray(b))
+    aw, bw = _as_words(a), _as_words(b)
+    if aw.size != bw.size:
+        raise ValueError("and_popcount operands differ in size")
+    return int(lib.and_popcount(_u32(aw), _u32(bw), aw.size))
+
+
+def plane_to_bits(plane: np.ndarray) -> np.ndarray:
+    """Set-bit positions of a plane as uint64 offsets."""
+    lib = _load()
+    plane = np.ascontiguousarray(plane.ravel(), dtype=np.uint32)
+    if lib is None:
+        bits = np.unpackbits(plane.view(np.uint8), bitorder="little")
+        return np.nonzero(bits)[0].astype(np.uint64)
+    n = int(lib.popcount_words(_u32(plane), plane.size))
+    out = np.empty(n, dtype=np.uint64)
+    lib.plane_to_bits(_u32(plane), plane.size,
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return out

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from pilosa_tpu import native
 from pilosa_tpu.shardwidth import BITS_PER_WORD, SHARD_WIDTH, WORDS_PER_SHARD
 
 # ---------------------------------------------------------------------------
@@ -40,21 +41,17 @@ def bits_to_plane(cols, words: int = WORDS_PER_SHARD) -> np.ndarray:
     (reference: roaring/roaring.go:2380 ImportRoaringBits).
     """
     plane = np.zeros(words, dtype=np.uint32)
-    cols = np.asarray(cols, dtype=np.uint64)
+    cols = np.asarray(cols, dtype=np.int64)
     if cols.size == 0:
         return plane
-    w = (cols // BITS_PER_WORD).astype(np.int64)
-    b = (cols % np.uint64(BITS_PER_WORD)).astype(np.uint32)
-    np.bitwise_or.at(plane, w, (np.uint32(1) << b))
+    native.scatter_bits(plane, cols)
     return plane
 
 
 def plane_to_bits(plane) -> np.ndarray:
     """Column offsets set in a plane (host-side; result materialization,
     reference: roaring/roaring.go Slice/iterators)."""
-    arr = np.asarray(plane, dtype="<u4")
-    bits = np.unpackbits(arr.view(np.uint8), bitorder="little")
-    return np.nonzero(bits)[0].astype(np.uint64)
+    return native.plane_to_bits(np.asarray(plane, dtype="<u4"))
 
 
 # ---------------------------------------------------------------------------
@@ -145,10 +142,10 @@ def zeros_varying_like(ref, shape, dtype):
 
 
 def host_popcount(x: np.ndarray) -> int:
-    """Host-side total popcount (oracle/baseline helper)."""
-    if hasattr(np, "bitwise_count"):
-        return int(np.bitwise_count(x).sum())
-    return int(np.unpackbits(np.ascontiguousarray(x).view(np.uint8)).sum())
+    """Host-side total popcount (native kernel; numpy fallback)."""
+    from pilosa_tpu import native
+
+    return native.popcount(np.ascontiguousarray(x))
 
 
 @jax.jit
