@@ -37,7 +37,12 @@ def _build(src: str) -> Optional[str]:
     .so path or None when no toolchain / compile failure."""
     cache = os.path.join(tempfile.gettempdir(),
                          f"pilosa_tpu_native_{os.getuid()}")
-    os.makedirs(cache, exist_ok=True)
+    os.makedirs(cache, mode=0o700, exist_ok=True)
+    st = os.stat(cache)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        # a pre-planted world/other-writable dir under /tmp could feed
+        # us someone else's .so — build in a private fresh dir instead
+        cache = tempfile.mkdtemp(prefix="pilosa_tpu_native_")
     tag = int(os.stat(src).st_mtime)
     so = os.path.join(cache, f"pilosa_native_{tag}.so")
     if os.path.exists(so):
@@ -130,6 +135,23 @@ def scatter_bits(plane: np.ndarray, cols: np.ndarray) -> None:
                          np.uint32(1) << (cols & 31).astype(np.uint32))
         return
     lib.scatter_bits(_u32(plane), _i64(cols), cols.size)
+
+
+def gather_bits(plane: np.ndarray, cols: np.ndarray) -> np.ndarray:
+    """uint8[len(cols)] of the plane's bits at each col (the read side
+    of the changed-bit accounting)."""
+    lib = _load()
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    _check_bounds(plane, cols)
+    if lib is None:
+        w = cols >> 5
+        b = (cols & 31).astype(np.uint32)
+        return (((plane[w] >> b) & np.uint32(1))).astype(np.uint8)
+    out = np.empty(cols.size, dtype=np.uint8)
+    lib.gather_bits(_u32(plane), _i64(cols),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    cols.size)
+    return out
 
 
 def scatter_new_bits(plane: np.ndarray, cols: np.ndarray) -> int:

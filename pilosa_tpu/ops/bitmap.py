@@ -143,8 +143,6 @@ def zeros_varying_like(ref, shape, dtype):
 
 def host_popcount(x: np.ndarray) -> int:
     """Host-side total popcount (native kernel; numpy fallback)."""
-    from pilosa_tpu import native
-
     return native.popcount(np.ascontiguousarray(x))
 
 

@@ -22,6 +22,7 @@ materializing ``rows x 2^20`` lanes in HBM.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,13 @@ from pilosa_tpu.ops.bitmap import zeros_varying_like
 # -> int8 chunk of [R, 65536] = 64KiB per row, MXU-friendly.
 BLOCK_WORDS = 2048
 
+# Pallas kernel tile sizes (VMEM-bounded; swept on v5e: BW=512/TR2=256
+# beat 1024/256, 512/512, 256/512): per step the expanded int8 lanes are
+# [R1p, 16384] + [256, 16384] = a few MB of VMEM.
+_PALLAS_BW = 512
+_PALLAS_TR2 = 256
+_PALLAS_MAX_R1 = 128  # larger outer sides would blow VMEM; swap or scan
+
 
 def _expand_bits_i8(words):
     """uint32[..., Wc] -> int8[..., Wc*32] of 0/1 lanes (LSB-first)."""
@@ -41,13 +49,120 @@ def _expand_bits_i8(words):
     return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(jnp.int8)
 
 
-@functools.partial(jax.jit, static_argnames=("block_words",))
 def pair_counts(a, b, block_words: int = BLOCK_WORDS):
     """int32[R1, R2] of pairwise intersection popcounts of two row sets
     ``uint32[R1, W]`` x ``uint32[R2, W]``.
 
     Used by GroupBy (rows of field1 x rows of field2) and by grouped
-    aggregates (group bitmaps x BSI magnitude planes)."""
+    aggregates (group bitmaps x BSI magnitude planes).
+
+    Dispatch: concrete arrays on a TPU backend take the fused Pallas
+    expand+matmul kernel (~1.9x the XLA scan — the expansion stays in
+    VMEM instead of staging int8 lanes through HBM); traced values
+    (inside jit/shard_map, e.g. the mesh path's psum reduction) and
+    other backends take the XLA scan. PILOSA_TPU_NO_PALLAS=1 forces the
+    scan."""
+    if _pallas_eligible(a, b):
+        try:
+            return _pair_counts_pallas(a, b)
+        except Exception as e:
+            # Loud fallback; transient device errors get retries, but
+            # repeated failures (a real lowering bug) stop burning
+            # compile attempts on every query.
+            global _PALLAS_FAILURES
+            _PALLAS_FAILURES += 1
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas pair_counts failed (%d/%d): %s — using XLA scan",
+                _PALLAS_FAILURES, _PALLAS_MAX_FAILURES, e)
+    return _pair_counts_xla(a, b, block_words)
+
+
+_PALLAS_FAILURES = 0
+_PALLAS_MAX_FAILURES = 3
+
+
+def _pallas_eligible(a, b) -> bool:
+    if _PALLAS_FAILURES >= _PALLAS_MAX_FAILURES \
+            or os.environ.get("PILOSA_TPU_NO_PALLAS"):
+        return False
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        return False
+    if a.ndim != 2 or b.ndim != 2 or a.shape[0] > _PALLAS_MAX_R1:
+        return False
+    if a.shape[1] == 0:
+        return False  # zero-width grid would never run the kernel
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _expand_bitmajor(x):
+    """uint32[R, BW] -> int8[R, 32*BW] of 0/1 lanes in BIT-MAJOR order
+    (block k holds bit k of every word). Any consistent permutation of
+    the contraction axis yields the same dot product, and 2D shifts +
+    concat vectorize on the VPU where a 3D->2D lane reshape does not
+    (Mosaic rejects it)."""
+    return jnp.concatenate(
+        [((x >> k) & 1).astype(jnp.int8) for k in range(32)], axis=1)
+
+
+def _pallas_kernel(a_ref, b_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    w = pl.program_id(1)  # innermost: contiguous revisits of the out
+    # block, the accumulation-safe grid order on TPU
+    blk = jax.lax.dot_general(
+        _expand_bitmajor(a_ref[:, :]), _expand_bitmajor(b_ref[:, :]),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32)
+
+    @pl.when(w == 0)
+    def _():
+        out_ref[:, :] = blk
+
+    @pl.when(w != 0)
+    def _():
+        out_ref[:, :] += blk
+
+
+@jax.jit
+def _pair_counts_pallas(a, b):
+    """Fused bit-expansion + int8 MXU matmul: the expansion lives in
+    VMEM per (512-word x 256-row) tile, so HBM sees only the packed
+    uint32 planes (measured 5.6 ms vs 10.7 ms XLA for the SSB config-3
+    contraction on v5e)."""
+    from jax.experimental import pallas as pl
+
+    r1, w_total = a.shape
+    r2, _ = b.shape
+    pad_w = (-w_total) % _PALLAS_BW
+    if pad_w:
+        a = jnp.pad(a, ((0, 0), (0, pad_w)))
+        b = jnp.pad(b, ((0, 0), (0, pad_w)))
+    r1p = max(8, -(-r1 // 8) * 8)  # sublane multiple, not just >= 8
+    if r1p != r1:
+        a = jnp.pad(a, ((0, r1p - r1), (0, 0)))
+    r2p = -(-r2 // _PALLAS_TR2) * _PALLAS_TR2
+    if r2p != r2:
+        b = jnp.pad(b, ((0, r2p - r2), (0, 0)))
+    out = pl.pallas_call(
+        _pallas_kernel,
+        grid=(r2p // _PALLAS_TR2, a.shape[1] // _PALLAS_BW),
+        in_specs=[
+            pl.BlockSpec((r1p, _PALLAS_BW), lambda t, w: (0, w)),
+            pl.BlockSpec((_PALLAS_TR2, _PALLAS_BW), lambda t, w: (t, w)),
+        ],
+        out_specs=pl.BlockSpec((r1p, _PALLAS_TR2), lambda t, w: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((r1p, r2p), jnp.int32),
+    )(a, b)
+    return out[:r1, :r2]
+
+
+@functools.partial(jax.jit, static_argnames=("block_words",))
+def _pair_counts_xla(a, b, block_words: int = BLOCK_WORDS):
+    """The XLA scan formulation (shard_map-compatible; all backends)."""
     r1, w = a.shape
     r2, _ = b.shape
     bw = min(block_words, w)

@@ -89,3 +89,29 @@ def test_engine_consistent_with_and_without_native(tmp_path):
     assert c1 == c2
     assert (f1.planes[: len(f1.row_ids)] ==
             f2.planes[: len(f2.row_ids)]).all()
+
+
+def test_gather_bits_both_backends(data):
+    W, cols = data
+    p = np.zeros(W, dtype=np.uint32)
+    native.scatter_bits(p, cols)
+    want = (((p[cols >> 5] >> (cols & 31).astype(np.uint32))
+             & np.uint32(1))).astype(np.uint8)
+    assert (native.gather_bits(p, cols) == want).all()
+    lib, tried = native._lib, native._tried
+    try:
+        native._lib, native._tried = None, True
+        assert (native.gather_bits(p, cols) == want).all()
+    finally:
+        native._lib, native._tried = lib, tried
+
+
+def test_scatter_bounds_checked(data):
+    W, _ = data
+    p = np.zeros(W, dtype=np.uint32)
+    for bad in ([-1], [W * 32]):
+        import pytest as _pytest
+        with _pytest.raises(IndexError):
+            native.scatter_bits(p, np.array(bad))
+        with _pytest.raises(IndexError):
+            native.scatter_new_bits(p, np.array(bad))
