@@ -101,6 +101,8 @@ class SelectStatement:
     items: List[SelectItem]
     table: Optional[str] = None
     table_alias: Optional[str] = None
+    #: derived-table source: FROM (SELECT ...) AS alias
+    derived: Optional["SelectStatement"] = None
     joins: List["JoinClause"] = dataclasses.field(default_factory=list)
     where: Optional[Expr] = None
     group_by: List[Expr] = dataclasses.field(default_factory=list)
@@ -125,6 +127,60 @@ class CreateView:
 class DropView:
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateFunction:
+    """CREATE FUNCTION name (@p type, ...) RETURNS type AS BEGIN...END
+    (reference: sql3/parser CreateFunctionStatement; evaluation is
+    refused by the reference too — userdefinedfunctions.go returns
+    'user defined functions' unsupported)."""
+    name: str
+    params: List[Tuple[str, str]]
+    returns: str
+    body: str
+    if_not_exists: bool = False
+    language: str = "sql"
+
+
+@dataclasses.dataclass
+class DropFunction:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateModel:
+    """CREATE MODEL (reference: parseCreateModelStatement; execution is
+    cloud-gated in the reference — registered here, PREDICT refuses)."""
+    name: str
+    options: str = ""
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropModel:
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class Predict:
+    """PREDICT USING model <select> (reference: PredictStatement)."""
+    model: str
+    select: "SelectStatement" = None
+
+
+@dataclasses.dataclass
+class CopyStatement:
+    """COPY src TO target [WHERE e] [WITH URL '...' [APIKEY '...']]
+    (reference: parseCopyStatement — ships rows to another FeatureBase;
+    here: local table copy, or remote over the client when URL given)."""
+    source: str
+    target: str
+    where: Optional[Expr] = None
+    url: Optional[str] = None
+    api_key: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -45,6 +45,11 @@ class SQLEngine:
         self.api = api
         self.planner = Planner(api)
         self.views = self.planner.views  # CREATE VIEW definitions
+        # CREATE FUNCTION / CREATE MODEL registries (reference:
+        # functionSystemObject; evaluation is refused in both codebases —
+        # userdefinedfunctions.go returns unsupported)
+        self.functions: dict = {}
+        self.models: dict = {}
 
     def query(self, sql: str, parsed=None) -> SQLResult:
         t0 = time.monotonic()
@@ -67,6 +72,7 @@ class SQLEngine:
         if isinstance(stmt, ast.SelectStatement):
             if stmt.table in _SYSTEM_TABLES:
                 return self._system_table(stmt)
+            self._reject_udf_calls(stmt)
             op = self.planner.plan_select(stmt)
             return SQLResult(schema=op.schema, data=[list(r) for r in op.rows()])
         if isinstance(stmt, ast.CreateTable):
@@ -88,6 +94,34 @@ class SQLEngine:
         if isinstance(stmt, ast.DeleteStatement):
             with self.api.txf.qcx():
                 return self._delete(stmt)
+        if isinstance(stmt, ast.CreateFunction):
+            return self._create_function(stmt)
+        if isinstance(stmt, ast.DropFunction):
+            name = stmt.name.lower()
+            if name not in self.functions and not stmt.if_exists:
+                raise SQLError(f"function {stmt.name!r} does not exist")
+            self.functions.pop(name, None)
+            return SQLResult(schema=[], data=[])
+        if isinstance(stmt, ast.CreateModel):
+            name = stmt.name.lower()
+            if name in self.models and not stmt.if_not_exists:
+                raise SQLError(f"model {stmt.name!r} already exists")
+            self.models[name] = stmt
+            return SQLResult(schema=[], data=[])
+        if isinstance(stmt, ast.DropModel):
+            name = stmt.name.lower()
+            if name not in self.models and not stmt.if_exists:
+                raise SQLError(f"model {stmt.name!r} does not exist")
+            self.models.pop(name, None)
+            return SQLResult(schema=[], data=[])
+        if isinstance(stmt, ast.Predict):
+            # registered but not executable — the reference gates model
+            # execution behind its cloud service the same way
+            if stmt.model.lower() not in self.models:
+                raise SQLError(f"model {stmt.model!r} does not exist")
+            raise SQLError("PREDICT is not supported on this deployment")
+        if isinstance(stmt, ast.CopyStatement):
+            return self._copy(stmt)
         if isinstance(stmt, ast.ShowTables):
             return self._show_tables()
         if isinstance(stmt, ast.ShowColumns):
@@ -95,6 +129,101 @@ class SQLEngine:
         if isinstance(stmt, ast.ShowDatabases):
             return SQLResult(schema=[("name", "STRING")], data=[])
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    def _create_function(self, cf: ast.CreateFunction) -> SQLResult:
+        name = cf.name.lower()  # function names are case-insensitive
+        if name in self.functions and not cf.if_not_exists:
+            raise SQLError(f"function {cf.name!r} already exists")
+        self.functions[name] = cf
+        return SQLResult(schema=[], data=[])
+
+    def _reject_udf_calls(self, stmt: ast.SelectStatement) -> None:
+        """A registered function referenced in a query errors exactly
+        like the reference (userdefinedfunctions.go: evaluation of user
+        defined functions is unsupported)."""
+        if not self.functions:
+            return
+        hits: List[str] = []
+
+        def walk(e):
+            if isinstance(e, ast.FuncCall):
+                if e.name.lower() in self.functions:
+                    hits.append(e.name.lower())
+                for a in e.args:
+                    walk(a)
+            elif dataclasses.is_dataclass(e):
+                for f in dataclasses.fields(e):
+                    v = getattr(e, f.name)
+                    if isinstance(v, ast.Expr):
+                        walk(v)
+                    elif isinstance(v, list):
+                        for x in v:
+                            if isinstance(x, ast.Expr):
+                                walk(x)
+        for it in stmt.items:
+            walk(it.expr)
+        if stmt.where is not None:
+            walk(stmt.where)
+        if hits:
+            raise SQLError("user defined functions are not supported "
+                           f"(function {hits[0]!r})")
+
+    def _copy(self, st: ast.CopyStatement) -> SQLResult:
+        """COPY source TO target: materialize the (optionally filtered)
+        source rows, then recreate schema + rows locally or on a remote
+        server over the client (reference: compilecopy.go ships rows to
+        another FeatureBase at ``URL``)."""
+        idx = self.api.holder.index(st.source)
+        sel = ast.SelectStatement(items=[ast.SelectItem(ast.Star())],
+                                  table=st.source, where=st.where)
+        op = self.planner.plan_select(sel)
+        names = [n for n, _ in op.schema]
+        rows = [list(r) for r in op.rows()]
+        id_type = "string" if idx.options.keys else "id"
+        cols_ddl = [f"_id {id_type}"] + [
+            f"{f.name} {field_to_sql_type(f.options).lower()}"
+            for f in idx.public_fields()]
+        ddl = (f"create table if not exists {st.target} "
+               f"({', '.join(cols_ddl)})")
+        if st.url:
+            from pilosa_tpu.client.client import Client
+
+            c = Client(st.url, token=st.api_key)
+            c.sql(ddl)
+            for i in range(0, len(rows), 1000):
+                chunk = rows[i:i + 1000]
+                if chunk:
+                    c.sql(self._insert_sql(st.target, names, chunk))
+            return SQLResult(schema=[], data=[], changed=len(rows))
+        self.query(ddl)
+        ins = ast.InsertStatement(
+            table=st.target, columns=names,
+            rows=[[ast.Literal(v) for v in row] for row in rows])
+        with self.api.txf.qcx():
+            self._insert(ins)
+        return SQLResult(schema=[], data=[], changed=len(rows))
+
+    @staticmethod
+    def _insert_sql(table: str, cols: List[str], rows: List[list]) -> str:
+        def lit(v) -> str:
+            if v is None:
+                return "null"
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, float):
+                s = repr(v)
+                if "e" in s or "E" in s:  # 1e-06 does not re-parse
+                    s = format(v, ".17f").rstrip("0").rstrip(".") or "0"
+                return s
+            if isinstance(v, int):
+                return repr(v)
+            if isinstance(v, list):
+                return "[" + ",".join(lit(x) for x in v) + "]"
+            return "'" + str(v).replace("'", "''") + "'"
+
+        vals = ",".join("(" + ",".join(lit(v) for v in row) + ")"
+                        for row in rows)
+        return (f"insert into {table} ({', '.join(cols)}) values {vals}")
 
     # -- DDL ------------------------------------------------------------------
 

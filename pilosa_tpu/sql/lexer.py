@@ -24,11 +24,13 @@ KEYWORDS = {
     "MIN", "MAX", "TIMEUNIT", "TIMEQUANTUM", "TTL", "CACHETYPE", "SIZE",
     "COMMENT", "KEYPARTITIONS", "EXTRACT", "CAST",
     "JOIN", "INNER", "LEFT", "OUTER", "ON", "VIEW",
+    "FUNCTION", "RETURNS", "BEGIN", "END", "MODEL", "PREDICT", "USING",
+    "COPY", "TO", "URL", "APIKEY", "LANGUAGE",
 }
 
 # multi-char operators first
 OPERATORS = ["<>", "!=", ">=", "<=", "=", "<", ">", "(", ")", ",", "*", "+",
-             "-", "/", "%", "[", "]", ".", ";"]
+             "-", "/", "%", "[", "]", ".", ";", "@"]
 
 
 @dataclasses.dataclass

@@ -87,6 +87,10 @@ class Parser:
             stmt = self.delete()
         elif self.at_kw("SHOW"):
             stmt = self.show()
+        elif self.at_kw("COPY"):
+            stmt = self.copy_statement()
+        elif self.at_kw("PREDICT"):
+            stmt = self.predict()
         else:
             raise SQLError(f"unexpected token {self.peek().value!r}")
         self.accept_op(";")
@@ -108,7 +112,14 @@ class Parser:
             if not self.accept_op(","):
                 break
         if self.accept_kw("FROM"):
-            s.table = self.ident()
+            if self.at_op("("):
+                # derived table: FROM (SELECT ...) [AS] alias (reference:
+                # sql3 subquery sources, defs_subquery.go)
+                self.next()
+                s.derived = self.select()
+                self.expect_op(")")
+            else:
+                s.table = self.ident()
             if self.accept_kw("AS"):
                 s.table_alias = self.ident()
             elif self.peek().kind == "IDENT":
@@ -174,6 +185,10 @@ class Parser:
         self.expect_kw("CREATE")
         if self.accept_kw("VIEW"):
             return self._create_view()
+        if self.at_kw("FUNCTION"):
+            return self._create_function()
+        if self.at_kw("MODEL"):
+            return self._create_model()
         self.expect_kw("TABLE")
         ine = False
         if self.accept_kw("IF"):
@@ -246,19 +261,112 @@ class Parser:
         return ast.CreateView(name=name, select=self.select(),
                               if_not_exists=ine)
 
-    def drop_table(self):
-        self.expect_kw("DROP")
-        if self.accept_kw("VIEW"):
-            ife = False
-            if self.accept_kw("IF"):
-                self.expect_kw("EXISTS")
-                ife = True
-            return ast.DropView(name=self.ident(), if_exists=ife)
-        self.expect_kw("TABLE")
-        ife = False
+    # -- dialect tail (reference: CreateFunctionStatement,
+    #    parseCreateModelStatement, parseCopyStatement,
+    #    parsePredictStatement) --------------------------------------------
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _create_function(self) -> ast.CreateFunction:
+        self.expect_kw("FUNCTION")
+        ine = self._if_not_exists()
+        name = self.ident()
+        params: list = []
+        self.expect_op("(")
+        if not self.at_op(")"):
+            while True:
+                self.expect_op("@")
+                pname = self.ident()
+                ptype = self.next().value.upper()
+                params.append((pname, ptype))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_kw("RETURNS")
+        rtype = self.next().value.upper()
+        self.expect_kw("AS")
+        self.expect_kw("BEGIN")
+        body: list = []
+        depth = 1
+        while True:
+            t = self.peek()
+            if t.kind == "EOF":
+                raise SQLError("unterminated function body (missing END)")
+            if t.kind == "KEYWORD" and t.value.upper() == "BEGIN":
+                depth += 1
+            elif t.kind == "KEYWORD" and t.value.upper() == "END":
+                depth -= 1
+                if depth == 0:
+                    self.next()
+                    break
+            body.append(str(self.next().value))
+        lang = "sql"
+        if self.accept_kw("LANGUAGE"):
+            lang = str(self.next().value).strip("'\"").lower()
+        return ast.CreateFunction(name=name, params=params, returns=rtype,
+                                  body=" ".join(body), if_not_exists=ine,
+                                  language=lang)
+
+    def _create_model(self) -> ast.CreateModel:
+        self.expect_kw("MODEL")
+        ine = self._if_not_exists()
+        name = self.ident()
+        # swallow the option/column tail verbatim (the reference's model
+        # options are cloud-side configuration)
+        opts: list = []
+        while self.peek().kind != "EOF" and not self.at_op(";"):
+            opts.append(str(self.next().value))
+        return ast.CreateModel(name=name, options=" ".join(opts),
+                               if_not_exists=ine)
+
+    def copy_statement(self) -> ast.CopyStatement:
+        self.expect_kw("COPY")
+        source = self.ident()
+        self.expect_kw("TO")
+        target = self.ident()
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.expr()
+        url = api_key = None
+        if self.accept_kw("WITH"):
+            while True:
+                if self.accept_kw("URL"):
+                    url = str(self.next().value)
+                elif self.accept_kw("APIKEY"):
+                    api_key = str(self.next().value)
+                else:
+                    break
+        return ast.CopyStatement(source=source, target=target, where=where,
+                                 url=url, api_key=api_key)
+
+    def predict(self) -> ast.Predict:
+        self.expect_kw("PREDICT")
+        self.expect_kw("USING")
+        model = self.ident()
+        sel = self.select()
+        return ast.Predict(model=model, select=sel)
+
+    def _if_exists(self) -> bool:
         if self.accept_kw("IF"):
             self.expect_kw("EXISTS")
-            ife = True
+            return True
+        return False
+
+    def drop_table(self):
+        self.expect_kw("DROP")
+        for kw, node in (("FUNCTION", ast.DropFunction),
+                         ("MODEL", ast.DropModel),
+                         ("VIEW", ast.DropView)):
+            if self.accept_kw(kw):
+                ife = self._if_exists()  # IF EXISTS precedes the name
+                return node(name=self.ident(), if_exists=ife)
+        self.expect_kw("TABLE")
+        ife = self._if_exists()
         return ast.DropTable(name=self.ident(), if_exists=ife)
 
     def alter_table(self) -> ast.AlterTable:
@@ -506,11 +614,20 @@ class Parser:
             if self.accept_op("."):
                 col = self.ident()
                 return ast.ColumnRef(col, table=name)
-            if t.kind == "KEYWORD" and name not in (
-                    "MIN", "MAX", "COMMENT", "SIZE", "TOP"):
+            if t.kind == "KEYWORD" and name not in _SOFT_KEYWORDS:
                 raise SQLError(f"unexpected keyword {name!r} in expression")
             return ast.ColumnRef(name if t.kind == "IDENT" else name.lower())
         raise SQLError(f"unexpected token {t.value!r} in expression")
+
+
+# Non-reserved keywords: usable as column names in expressions (the
+# dialect-tail statement keywords must not break schemas that already
+# use names like `url` or `model`).
+_SOFT_KEYWORDS = frozenset({
+    "MIN", "MAX", "COMMENT", "SIZE", "TOP",
+    "URL", "APIKEY", "MODEL", "FUNCTION", "LANGUAGE", "RETURNS",
+    "BEGIN", "END", "COPY", "TO", "PREDICT", "USING",
+})
 
 
 def parse_statement(src: str):

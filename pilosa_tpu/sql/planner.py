@@ -60,6 +60,15 @@ class Planner:
     # -- entry ---------------------------------------------------------------
 
     def plan_select(self, s: ast.SelectStatement) -> PlanOp:
+        if s.derived is not None:
+            # derived table: the outer select runs over the subquery's
+            # row stream, exactly like a view over its definition
+            # (reference: defs_subquery.go FROM (SELECT ...) sources)
+            if s.joins:
+                raise SQLError(
+                    "JOIN over a derived table is not supported")
+            inner = self.plan_select(s.derived)
+            return self._plan_over_inner(s, inner, "subquery")
         if s.table is None:
             return self._select_no_table(s)
         if s.joins:
@@ -712,6 +721,14 @@ class Planner:
             inner = self.plan_select(self.views[name])
         finally:
             expanding.discard(name)
+        return self._plan_over_inner(s, inner, f"view {name!r}")
+
+    def _plan_over_inner(self, s: ast.SelectStatement, inner: PlanOp,
+                         label: str) -> PlanOp:
+        """Outer select over an already-planned row stream (views AND
+        derived tables share this; PQL pushdown happened INSIDE the
+        inner plan — the outer layer is host ops on the reduced
+        stream)."""
         s = _strip_single_table_quals(s)
         types = dict(inner.schema)
 
@@ -719,7 +736,7 @@ class Planner:
             if isinstance(e, ast.ColumnRef):
                 if e.name not in types:
                     raise SQLError(
-                        f"unknown column {e.name!r} in view {name!r}")
+                        f"unknown column {e.name!r} in {label}")
                 return types[e.name]
             if isinstance(e, ast.FuncCall):
                 if e.name == "COUNT":

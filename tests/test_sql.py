@@ -359,3 +359,105 @@ def test_bulk_insert_missing_values(eng):
                   "ALLOW_MISSING_VALUES")
     assert r.changed == 2
     assert q(eng, "SELECT b FROM bm WHERE _id = 2") == [[None]]
+
+
+class TestDialectTail:
+    """CREATE FUNCTION / MODEL, PREDICT, COPY (reference:
+    sql3 CreateFunctionStatement + userdefinedfunctions.go [evaluation
+    unsupported there too], parseCreateModelStatement, compilecopy.go
+    [ships rows to another FeatureBase]; VERDICT r4 missing #6)."""
+
+    def test_function_registry_and_refusal(self):
+        from pilosa_tpu.sql.lexer import SQLError
+
+        api = API()
+        api.sql("create table ft (_id id, v int)")
+        api.sql("insert into ft values (1, 5)")
+        api.sql("create function f1 (@x int, @y string) returns int "
+                "as begin end")
+        # duplicate fails; IF NOT EXISTS is idempotent
+        with pytest.raises(SQLError):
+            api.sql("create function f1 (@x int) returns int as begin end")
+        api.sql("create function if not exists f1 (@x int) returns int "
+                "as begin end")
+        with pytest.raises(SQLError, match="user defined functions"):
+            api.sql("select f1(v) from ft")
+        api.sql("drop function f1")
+        with pytest.raises(SQLError):
+            api.sql("drop function f1")
+        api.sql("drop function if exists f1")
+        assert api.sql("select v from ft").data == [[5]]
+
+    def test_model_and_predict(self):
+        from pilosa_tpu.sql.lexer import SQLError
+
+        api = API()
+        api.sql("create table mt (_id id, v int)")
+        api.sql("create model m1 (v int) with budget 100")
+        with pytest.raises(SQLError, match="PREDICT is not supported"):
+            api.sql("predict using m1 select v from mt")
+        with pytest.raises(SQLError, match="does not exist"):
+            api.sql("predict using nosuch select v from mt")
+
+    def test_copy_local(self):
+        api = API()
+        api.sql("create table csrc (_id id, v int, tags stringset)")
+        api.sql("insert into csrc values (1, 5, ['a','b']), (2, 9, ['b']), "
+                "(3, 2, null)")
+        r = api.sql("copy csrc to cdst where v > 3")
+        assert r.changed == 2
+        assert api.sql("select _id, v from cdst").data == [[1, 5], [2, 9]]
+        assert api.sql(
+            "select count(*) from cdst where setcontains(tags, 'b')"
+        ).data == [[2]]
+
+    def test_copy_remote_over_client(self):
+        from pilosa_tpu.server.http import serve
+
+        src = API()
+        src.sql("create table r1 (_id string, v int, s string)")
+        src.sql("insert into r1 values ('a', 1, 'x'), ('b', 2, 'it''s')")
+        dst = API()
+        srv, _ = serve(dst, port=0, background=True)
+        host, port = srv.server_address[:2]
+        try:
+            r = src.sql(f"copy r1 to r2 with url 'http://{host}:{port}'")
+            assert r.changed == 2
+            got = dst.sql("select _id, v, s from r2").data
+            assert sorted(map(tuple, got)) == [
+                ("a", 1, "x"), ("b", 2, "it's")]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_tail_regressions(self):
+        from pilosa_tpu.sql.lexer import SQLError
+
+        api = API()
+        # new statement keywords stay usable as column names
+        api.sql("create table kw (_id id, url string, model int)")
+        api.sql("insert into kw values (1, 'http://x', 7)")
+        assert api.sql("select url, model from kw where model > 3"
+                       ).data == [["http://x", 7]]
+        # mixed-case function names normalize
+        api.sql("create function MyFunc (@x int) returns int as begin end")
+        with pytest.raises(SQLError, match="user defined functions"):
+            api.sql("select myfunc(model) from kw")
+        with pytest.raises(SQLError, match="already exists"):
+            api.sql("create function MYFUNC (@x int) returns int "
+                    "as begin end")
+        api.sql("drop function myfunc")
+        # drop model exists; drop table if exists still parses
+        api.sql("create model mm (v int)")
+        api.sql("drop model mm")
+        api.sql("drop table if exists notthere")
+        # JOIN over a derived table errors instead of silently dropping
+        with pytest.raises(SQLError, match="derived table"):
+            api.sql("select * from (select _id from kw) d "
+                    "inner join kw on d._id = kw._id")
+        # scientific-notation floats survive the remote-insert format
+        from pilosa_tpu.sql.engine import SQLEngine
+        txt = SQLEngine._insert_sql("t", ["_id", "d"], [[1, 1e-06]])
+        api.sql("create table t (_id id, d decimal(6))")
+        api.sql(txt)
+        assert api.sql("select d from t").data == [[1e-06]]

@@ -65,6 +65,10 @@ SETUP = [
     "create table neg (_id id, n int, d decimal(2))",
     "insert into neg values (1, -11, -11.50), (2, -22, -0.25), "
     "(3, 33, 3.75), (4, 0, 0.00)",
+    # defs_subquery.go model (subquerytable)
+    "create table subq (_id id, an_int int, a_string string)",
+    "insert into subq values (1, 10, 'str1'), (2, 20, 'str1'), "
+    "(3, 30, 'str2'), (4, 40, 'str3')",
 ]
 
 # (name, sql, expected rows, ordered)
@@ -356,6 +360,22 @@ CASES = [
     ("null-isnull-notnull",
      "select _id from nulls where a is not null and s is not null",
      [[1]], False),
+    # -- FROM-subqueries / derived tables (defs_subquery.go) ---------------
+    ("subq-sum-of-counts",
+     "select sum(mycount) as thecount from (select count(a_string) as "
+     "mycount, a_string from subq group by a_string)", [[4]], False),
+    ("subq-sum-of-distinct-counts",
+     "select sum(mycount) as thecount from (select count(distinct "
+     "a_string) as mycount, a_string from subq group by a_string)",
+     [[3]], False),
+    ("subq-outer-where",
+     "select a_string, total from (select a_string, sum(an_int) as "
+     "total from subq group by a_string) t where total > 25",
+     [["str1", 30], ["str2", 30], ["str3", 40]], False),
+    ("subq-nested",
+     "select max(total) from (select sum(an_int) as total from "
+     "(select a_string, an_int from subq) x group by a_string) y",
+     [[40]], False),
     # -- multi-shard (cluster distribution) --------------------------------
     ("big-count", "select count(*) from big", [[4]], False),
     ("big-sum", "select sum(n) from big", [[10]], False),
