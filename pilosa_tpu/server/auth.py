@@ -172,6 +172,13 @@ ROUTE_LEVELS: Dict[str, tuple] = {
     "get_dataframe_schema": ("read", True),
     "get_transaction": ("read", False),
     "get_transactions": ("read", False),
+    # round-5 read surface (siblings of /schema and /query-history)
+    "get_schema_details": ("read", False),
+    "get_queries": ("read", False),
+    "get_shard_distribution": ("read", False),
+    "get_internal_nodes": ("read", False),
+    "get_shards_max": ("read", False),
+    "get_index_shards": ("read", True),
     # writes
     "post_index": ("admin", True),
     "delete_index": ("admin", True),

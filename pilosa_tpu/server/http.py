@@ -67,6 +67,26 @@ _ROUTES = [
     ("POST", re.compile(r"^/sql$"), "post_sql"),
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("GET", re.compile(r"^/status$"), "get_status"),
+    ("GET", re.compile(r"^/version$"), "get_version"),
+    ("GET", re.compile(r"^/health$"), "get_health"),
+    ("GET", re.compile(r"^/schema/details$"), "get_schema_details"),
+    ("GET", re.compile(r"^/internal/nodes$"), "get_internal_nodes"),
+    ("GET", re.compile(r"^/internal/shards/max$"), "get_shards_max"),
+    ("GET", re.compile(r"^/internal/index/([^/]+)/shards$"),
+     "get_index_shards"),
+    ("GET", re.compile(r"^/internal/partition/nodes$"),
+     "get_partition_nodes"),
+    ("GET", re.compile(r"^/internal/oauth-config$"), "get_oauth_config"),
+    ("GET", re.compile(r"^/userinfo$"), "get_userinfo"),
+    ("GET", re.compile(r"^/queries$"), "get_queries"),
+    ("POST", re.compile(r"^/recalculate-caches$"), "post_recalculate_caches"),
+    ("GET", re.compile(r"^/ui/shard-distribution$"),
+     "get_shard_distribution"),
+    ("POST", re.compile(r"^/cpu-profile/start$"), "post_cpu_profile_start"),
+    ("POST", re.compile(r"^/cpu-profile/stop$"), "post_cpu_profile_stop"),
+    ("POST", re.compile(
+        r"^/internal/translate/field/([^/]+)/([^/]+)/keys/like$"),
+     "post_translate_field_keys_like"),
     ("GET", re.compile(r"^/info$"), "get_info"),
     # per-shard snapshot stream (reference: api.go:1265 IndexShardSnapshot
     # via /internal/index/{i}/shard/{s}/snapshot)
@@ -113,8 +133,10 @@ _ROUTES = [
     ("GET", re.compile(r"^/logout$"), "get_logout"),
 ]
 
-# The login flow itself must be reachable without credentials.
-_AUTH_EXEMPT = {"get_login", "get_redirect", "get_logout"}
+# The login flow (and liveness/identity probes) must be reachable
+# without credentials; /userinfo authenticates via its own cookies.
+_AUTH_EXEMPT = {"get_login", "get_redirect", "get_logout",
+                "get_version", "get_health", "get_userinfo"}
 
 
 def _token_cookies(access: str, refresh: str, expire: bool = False):
@@ -307,12 +329,8 @@ class Handler(BaseHTTPRequestHandler):
         stmt = parse_statement(text)
         ctx = self._auth_ctx
         if isinstance(stmt, sql_ast.SelectStatement):
-            from pilosa_tpu.sql.engine import _SYSTEM_TABLES
-
-            tables = [stmt.table] + [j.table for j in stmt.joins]
-            for t in tables:
-                if t is not None and t not in _SYSTEM_TABLES:
-                    self.auth.authorize(ctx, "read", t)
+            for t in self._select_tables(stmt):
+                self.auth.authorize(ctx, "read", t)
             return stmt
         if isinstance(stmt, sql_ast.ShowColumns):
             self.auth.authorize(ctx, "read", stmt.table)
@@ -326,9 +344,37 @@ class Handler(BaseHTTPRequestHandler):
             # DELETE /index/{i} which checks admin on i)
             self.auth.authorize(ctx, "admin", stmt.name)
             return stmt
+        if isinstance(stmt, sql_ast.CopyStatement):
+            # read on the source, admin for the implicit target CREATE;
+            # shipping rows to an external URL is an export -> admin too
+            self.auth.authorize(ctx, "read", stmt.source)
+            if stmt.url:
+                self.auth.authorize(ctx, "admin", None)
+            else:
+                self.auth.authorize(ctx, "admin", stmt.target)
+            return stmt
         table = getattr(stmt, "table", None) or getattr(stmt, "name", None)
         self._require_write(table)
         return stmt
+
+    def _select_tables(self, stmt) -> list:
+        """Every base table a SELECT reads, recursing into FROM-
+        subqueries — a derived table must not bypass per-table read
+        grants."""
+        from pilosa_tpu.sql import ast as sql_ast
+        from pilosa_tpu.sql.engine import _SYSTEM_TABLES
+
+        out: list = []
+
+        def walk(s: "sql_ast.SelectStatement"):
+            if s.derived is not None:
+                walk(s.derived)
+            if s.table is not None and s.table not in _SYSTEM_TABLES:
+                out.append(s.table)
+            for j in s.joins:
+                out.append(j.table)
+        walk(stmt)
+        return out
 
     def post_index(self, index: str):
         self.api.create_index(index, self._json_body().get("options"))
@@ -494,6 +540,196 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._send(200, {"state": "NORMAL", "indexes": sorted(
             self.api.holder.indexes)})
+
+    def get_version(self):
+        """(reference: /version, http_handler.go handleGetVersion)."""
+        from pilosa_tpu import __version__
+
+        self._send(200, {"version": __version__})
+
+    def get_health(self):
+        """Liveness probe (reference: /health — 200 while serving)."""
+        self._send(200, {"state": "healthy"})
+
+    def get_schema_details(self):
+        """Schema with per-field detail incl. row cardinality (reference:
+        /schema/details includes cardinality the plain /schema omits)."""
+        out = []
+        for iname in sorted(self.api.holder.indexes):
+            idx = self.api.holder.index(iname)
+            fields = []
+            for f in idx.public_fields():
+                if f.options.type.is_bsi:
+                    # BSI fields: distinct stored values via the
+                    # device-accelerated Distinct kernel
+                    if f.bsi:
+                        card = self.api.query(
+                            iname, f"Count(Distinct(field={f.name}))")[0]
+                    else:
+                        card = 0
+                else:
+                    rows = set()
+                    for frags in list(f.views.values()):
+                        for frag in list(frags.values()):
+                            rows.update(frag.existing_rows())
+                    card = len(rows)
+                fields.append({"name": f.name,
+                               "options": f.options.to_json(),
+                               "cardinality": card})
+            out.append({"name": iname, "fields": fields,
+                        "options": idx.options.to_json()})
+        self._send(200, {"indexes": out})
+
+    def get_internal_nodes(self):
+        """(reference: /internal/nodes — the membership list)."""
+        snap_fn = getattr(self.api, "snapshot", None)
+        if snap_fn is None:
+            self._send(200, [{"id": "local", "uri": "", "state": "STARTED"}])
+            return
+        self._send(200, [n.to_json() for n in snap_fn().nodes])
+
+    def get_shards_max(self):
+        """(reference: /internal/shards/max — max shard per index)."""
+        out = {}
+        for iname in self.api.holder.indexes:
+            idx = self.api.holder.index(iname)
+            shards = set()
+            for f in idx.fields.values():
+                shards |= f.shards()
+            out[iname] = max(shards) if shards else 0
+        self._send(200, {"standard": out})
+
+    def get_index_shards(self, index: str):
+        """(reference: /internal/index/{i}/shards)."""
+        all_fn = getattr(self.api, "all_shards", None)
+        if all_fn is not None:
+            shards = sorted(all_fn(index))
+        else:
+            idx = self.api.holder.index(index)
+            shards = sorted(set().union(
+                *[f.shards() for f in idx.fields.values()]) or set())
+        self._send(200, {"shards": shards})
+
+    def get_partition_nodes(self):
+        """(reference: /internal/partition/nodes?partition=N)."""
+        from urllib.parse import parse_qs, urlsplit
+
+        self._node_only()
+        q = parse_qs(urlsplit(self.path).query)
+        p = int((q.get("partition") or ["0"])[0])
+        snap = self.api.snapshot()
+        self._send(200, [n.to_json() for n in snap.partition_nodes(p)])
+
+    def get_oauth_config(self):
+        """(reference: /internal/oauth-config — the IdP config minus the
+        client secret, authenticate.go CleanOAuthConfig)."""
+        oidc = getattr(self.auth, "oidc", None) if self.auth else None
+        if oidc is None:
+            raise KeyError("OIDC not configured")
+        c = oidc.config
+        self._send(200, {"authUrl": c.auth_url, "tokenUrl": c.token_url,
+                         "groupEndpoint": c.group_endpoint,
+                         "logoutEndpoint": c.logout_endpoint,
+                         "clientId": c.client_id,
+                         "redirectUrl": c.redirect_url,
+                         "scopes": c.scopes})
+
+    def get_userinfo(self):
+        """(reference: /userinfo — the cookie session's identity)."""
+        from pilosa_tpu.server.auth import AuthError, _auth_cookies
+
+        oidc = getattr(self.auth, "oidc", None) if self.auth else None
+        if oidc is None:
+            raise KeyError("OIDC not configured")
+        access, refresh = _auth_cookies(self.headers)
+        try:
+            info = oidc.authenticate(access, refresh)
+        except AuthError as e:
+            self._send(e.code, {"error": str(e)})
+            return
+        if info.get("rotated"):
+            # re-set cookies, or a one-time-use refresh token is lost
+            self._pending_cookies = _token_cookies(
+                info["access"], info["refresh"])
+        self._send(200, {"userid": info["userid"],
+                         "username": info["username"],
+                         "groups": [{"id": g} for g in info["groups"]]})
+
+    def get_queries(self):
+        """Currently executing queries (reference: /queries; completed
+        history rides /query-history)."""
+        hist = getattr(self.api, "history", None)
+        if hist is None:
+            self._send(200, {"queries": []})
+            return
+        self._send(200, {"queries": [r.to_json() for r in hist.list()
+                                     if r.status == "running"]})
+
+    def post_recalculate_caches(self):
+        """(reference: /recalculate-caches — forces TopN cache rebuilds;
+        this engine recounts on device, so there is nothing to rebuild
+        and the call acks immediately.)"""
+        self._send(200, {})
+
+    def get_shard_distribution(self):
+        """(reference: /ui/shard-distribution — shard->node placement)."""
+        snap_fn = getattr(self.api, "snapshot", None)
+        out: dict = {}
+        for iname in sorted(self.api.holder.indexes):
+            if snap_fn is None:
+                out[iname] = {"local": sorted(
+                    set().union(*[f.shards() for f in self.api.holder
+                                  .index(iname).fields.values()])
+                    or set())}
+                continue
+            snap = snap_fn()
+            all_fn = getattr(self.api, "all_shards", None)
+            shards = sorted(all_fn(iname)) if all_fn else []
+            per: dict = {}
+            for s in shards:
+                owner = snap.shard_nodes(iname, s)[0].id
+                per.setdefault(owner, []).append(s)
+            out[iname] = per
+        self._send(200, out)
+
+    def post_cpu_profile_start(self):
+        """(reference: /cpu-profile/start — process-wide profile until
+        /cpu-profile/stop)."""
+        import cProfile
+
+        cls = type(self)
+        if getattr(cls, "_cpu_profile", None) is not None:
+            raise ValueError("cpu profile already running")
+        cls._cpu_profile = cProfile.Profile()
+        cls._cpu_profile.enable()
+        self._send(200, {})
+
+    def post_cpu_profile_stop(self):
+        import io as _io
+        import pstats
+
+        cls = type(self)
+        prof = getattr(cls, "_cpu_profile", None)
+        if prof is None:
+            raise ValueError("no cpu profile running")
+        prof.disable()
+        cls._cpu_profile = None
+        s = _io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(50)
+        self._send(200, {"profile": s.getvalue().splitlines()})
+
+    def post_translate_field_keys_like(self, index: str, field: str):
+        """(reference: /internal/translate/.../keys/like — LIKE-pattern
+        row-key search used by SQL LIKE pushdown on keyed fields). Uses
+        the engine's own LIKE semantics (metachars escaped, case-
+        insensitive) so pushdown and host evaluation agree."""
+        from pilosa_tpu.sql.plan import _like_to_regex
+
+        pat = self._json_body().get("like") or ""
+        rx = _like_to_regex(pat)
+        store = self._translate_store(index, field)
+        out = {k: v for k, v in store.key_to_id.items() if rx.match(k)}
+        self._send(200, {"ids": out})
 
     # -- internal (node-to-node) handlers ---------------------------------
 

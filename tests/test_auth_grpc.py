@@ -550,3 +550,117 @@ class TestOIDC:
 class _NoRedirect(urllib.request.HTTPRedirectHandler):
     def redirect_request(self, *a, **k):
         return None
+
+
+class TestOIDCInfoRoutes:
+    def test_userinfo_and_oauth_config(self):
+        from pilosa_tpu.server.oidc import FakeIdP, OAuthConfig, OIDCAuth
+
+        idp = FakeIdP(groups=[{"id": READ_G, "displayName": "readers"}])
+        base_idp = idp.serve()
+        api = API()
+        cfg = OAuthConfig(auth_url=base_idp + "/authorize",
+                          token_url=base_idp + "/token",
+                          group_endpoint=base_idp + "/groups",
+                          client_id="cid", client_secret="SECRETVALUE")
+        auth = Auth(SECRET, PERMS, oidc=OIDCAuth(cfg))
+        srv, _ = serve(api, port=0, background=True, auth=auth)
+        host, port = srv.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            access = idp.mint("carol")
+            req = urllib.request.Request(base + "/userinfo")
+            req.add_header("Cookie", f"molecula-chip={access}")
+            with urllib.request.urlopen(req) as r:
+                info = json.loads(r.read())
+            assert info["userid"] == "carol"
+            assert info["groups"] == [{"id": READ_G}]
+            # oauth-config needs admin (unlisted internal route) and must
+            # not leak the client secret
+            tok = issue_token(SECRET, [ADMIN_G], subject="admin")
+            req = urllib.request.Request(base + "/internal/oauth-config")
+            req.add_header("Authorization", "Bearer " + tok)
+            with urllib.request.urlopen(req) as r:
+                conf = json.loads(r.read())
+            assert conf["clientId"] == "cid"
+            assert "SECRETVALUE" not in json.dumps(conf)
+            # no cookies -> 401 from userinfo
+            try:
+                urllib.request.urlopen(base + "/userinfo")
+                raise AssertionError("expected 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            idp.close()
+
+
+class TestSQLAuthzTail:
+    """Round-5 review findings: FROM-subqueries and COPY must not bypass
+    per-table grants."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        api = API()
+        for t in ("pub", "secret"):
+            api.create_index(t)
+            api.holder.index(t).create_field(
+                "v", __import__("pilosa_tpu.core.schema",
+                                fromlist=["FieldOptions", "FieldType"]
+                                ).FieldOptions(
+                    type=__import__("pilosa_tpu.core.schema",
+                                    fromlist=["FieldType"]).FieldType.INT))
+        api.sql("insert into pub (_id, v) values (1, 1)")
+        api.sql("insert into secret (_id, v) values (1, 99)")
+        perms = Permissions(user_groups={
+            READ_G: {"pub": "read"},
+            WRITE_G: {"pub": "write"},
+        }, admin=ADMIN_G)
+        srv, _ = serve(api, port=0, background=True,
+                       auth=Auth(SECRET, perms))
+        host, port = srv.server_address[:2]
+        yield f"http://{host}:{port}"
+        srv.shutdown()
+        srv.server_close()
+
+    def _sql(self, base, text, groups):
+        tok = issue_token(SECRET, groups, subject="u")
+        req = urllib.request.Request(base + "/sql", data=text.encode(),
+                                     method="POST")
+        req.add_header("Content-Type", "text/plain")
+        req.add_header("Authorization", "Bearer " + tok)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_derived_table_needs_source_read(self, server):
+        code, _ = self._sql(server, "select v from pub", [READ_G])
+        assert code == 200
+        code, _ = self._sql(server, "select v from secret", [READ_G])
+        assert code == 403
+        # the bypass: wrapping in a FROM-subquery must NOT help
+        code, _ = self._sql(
+            server, "select v from (select v from secret) x", [READ_G])
+        assert code == 403
+        code, body = self._sql(
+            server, "select v from (select v from pub) x", [READ_G])
+        assert code == 200 and body["data"] == [[1]]
+
+    def test_copy_needs_read_and_admin(self, server):
+        # write grant on pub alone: cannot read secret via COPY
+        code, _ = self._sql(server, "copy secret to leak", [WRITE_G])
+        assert code == 403
+        # read on source but no admin: still refused (implicit CREATE)
+        code, _ = self._sql(server, "copy pub to pub2", [READ_G])
+        assert code == 403
+        # external URL needs admin even with read on source
+        code, _ = self._sql(
+            server, "copy pub to x with url 'http://127.0.0.1:1'",
+            [READ_G, WRITE_G])
+        assert code == 403
+        # admin may copy
+        code, _ = self._sql(server, "copy pub to pub2", [ADMIN_G])
+        assert code == 200

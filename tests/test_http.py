@@ -298,3 +298,79 @@ class TestSurfaceCompletion:
             out = json.loads(r.read())
         assert out["results"] == [2]
         assert any("cumulative" in line for line in out["profile"])
+
+
+class TestRouteSurfaceTail:
+    """Round-5 HTTP surface additions (reference: http_handler.go routes
+    /version /health /schema/details /internal/nodes /internal/shards/max
+    /internal/index/{i}/shards /ui/shard-distribution /queries
+    /recalculate-caches /cpu-profile/*)."""
+
+    @pytest.fixture(scope="class")
+    def base(self):
+        api = API()
+        api.create_index("rt")
+        api.create_field("rt", "f")
+        api.query("rt", "Set(1, f=2)Set(1048577, f=3)")
+        srv, _ = serve(api, port=0, background=True)
+        host, port = srv.server_address[:2]
+        yield f"http://{host}:{port}"
+        srv.shutdown()
+        srv.server_close()
+
+    def _get(self, url):
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(url) as r:
+            return _json.loads(r.read())
+
+    def _post(self, url, body=b"{}"):
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(url, data=body, method="POST")
+        with urllib.request.urlopen(req) as r:
+            return _json.loads(r.read())
+
+    def test_version_health(self, base):
+        assert self._get(base + "/version")["version"]
+        assert self._get(base + "/health")["state"] == "healthy"
+
+    def test_schema_details_cardinality(self, base):
+        det = self._get(base + "/schema/details")
+        fld = det["indexes"][0]["fields"][0]
+        assert fld["name"] == "f" and fld["cardinality"] == 2
+
+    def test_shards_surfaces(self, base):
+        assert self._get(base + "/internal/shards/max")["standard"]["rt"] == 1
+        assert self._get(base + "/internal/index/rt/shards")["shards"] == [0, 1]
+        dist = self._get(base + "/ui/shard-distribution")
+        assert dist["rt"]["local"] == [0, 1]
+        nodes = self._get(base + "/internal/nodes")
+        assert nodes and nodes[0]["id"]
+
+    def test_queries_and_caches(self, base):
+        assert self._get(base + "/queries")["queries"] == []
+        assert self._post(base + "/recalculate-caches") == {}
+
+    def test_cpu_profile_roundtrip(self, base):
+        self._post(base + "/cpu-profile/start")
+        self._get(base + "/schema")
+        out = self._post(base + "/cpu-profile/stop")
+        assert any("cumulative" in line for line in out["profile"])
+
+    def test_translate_keys_like(self):
+        api = API()
+        api.create_index("lk", {"keys": True})
+        api.create_field("lk", "tag", {"keys": True})
+        api.import_bits("lk", "tag", row_keys=["alpha", "beta", "alto"],
+                        col_keys=["a", "b", "c"])
+        srv, _ = serve(api, port=0, background=True)
+        host, port = srv.server_address[:2]
+        try:
+            out = self._post(f"http://{host}:{port}"
+                             "/internal/translate/field/lk/tag/keys/like",
+                             b'{"like": "al%"}')
+            assert sorted(out["ids"]) == ["alpha", "alto"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
