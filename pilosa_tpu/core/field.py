@@ -330,6 +330,13 @@ class Field:
             return [timeq.VIEW_STANDARD]
         if self.options.type != FieldType.TIME:
             raise ValueError(f"field {self.name} is not a time field")
-        lo = from_t or dt.datetime(1, 1, 1)
-        hi = to_t or dt.datetime(9999, 1, 1)
-        return timeq.views_by_time_range(lo, hi, self.options.time_quantum)
+        # default bounds adopt the other side's tzinfo — naive-vs-aware
+        # comparison raises in the cover recursion
+        tz = (from_t or to_t).tzinfo
+        lo = from_t or dt.datetime(1, 1, 1, tzinfo=tz)
+        hi = to_t or dt.datetime(9999, 1, 1, tzinfo=tz)
+        views = timeq.views_by_time_range(lo, hi, self.options.time_quantum)
+        # open-ended ranges cover millennia of candidate view names;
+        # only views holding data can contribute (reference reads are
+        # bounded the same way — absent views have no fragments)
+        return [v for v in views if v in self.views]

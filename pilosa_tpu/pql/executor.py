@@ -308,6 +308,27 @@ class Executor:
                 if c.name != "Rows":
                     raise PQLError("UnionRows children must be Rows calls")
                 field = idx.field(self._field_name(c))
+                from_a, to_a = c.arg("from"), c.arg("to")
+                if from_a is not None or to_a is not None:
+                    # records with ANY matching event in the range: OR of
+                    # the selected row planes across the covering quantum
+                    # views (the lowering of SQL rangeq(); reference:
+                    # view-ranged Rows feeding executeUnionRows)
+                    views = field.range_views(
+                        _parse_ts(from_a) if from_a is not None else None,
+                        _parse_ts(to_a) if to_a is not None else None)
+                    restricted = (c.arg("limit") is not None
+                                  or c.arg("previous") is not None
+                                  or c.arg("column") is not None)
+                    # _rows_list honors from/to together with the
+                    # limit/previous/column options
+                    rows = (self._rows_list(idx, c, shard_list)
+                            if restricted else None)
+                    for v in views:
+                        st = stacked_set(field, shard_list, v)
+                        sel = st.row_ids if rows is None else rows
+                        out = B.plane_or(out, st.rows_plane(sel))
+                    continue
                 st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
                 if (c.arg("limit") is None and c.arg("previous") is None
                         and c.arg("column") is None):

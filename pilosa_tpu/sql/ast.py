@@ -34,6 +34,13 @@ class Star(Expr):
 
 
 @dataclasses.dataclass
+class TupleLiteral(Expr):
+    """{a, b}: only meaningful as a quantum {timestamp, set} insert
+    value (reference: sql3 tuple literals, defs_timequantum.go)."""
+    items: List[Expr] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class Binary(Expr):
     op: str  # = != < <= > >= AND OR + - * / %
     left: Expr

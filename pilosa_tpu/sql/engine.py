@@ -17,7 +17,8 @@ from pilosa_tpu.core.schema import FieldType
 from pilosa_tpu.sql import ast
 from pilosa_tpu.sql.lexer import SQLError
 from pilosa_tpu.sql.parser import parse_statement
-from pilosa_tpu.sql.plan import PlanOp, Schema, StaticOp, eval_expr
+from pilosa_tpu.sql.plan import (PlanOp, QuantumSet, Schema, StaticOp,
+                                 eval_expr)
 from pilosa_tpu.sql.planner import Planner
 from pilosa_tpu.sql.types import column_to_field_options, \
     column_to_options_dict, field_to_sql_type, id_sql_type
@@ -38,6 +39,20 @@ class SQLResult:
             "rows-affected": self.changed,
             "execution-time": int(self.exec_ms * 1000),  # µs like the ref
         }
+
+
+def _validate_quantum(name: str, t, v: "QuantumSet") -> None:
+    """Shared INSERT/REPLACE validation of a {ts, set} tuple value."""
+    from pilosa_tpu.sql.plan import _parse_ts
+
+    if t != FieldType.TIME:
+        raise SQLError(
+            f"a tuple expression cannot be assigned to column {name!r} "
+            "(not a time-quantum field)")
+    try:
+        _parse_ts(v.ts)
+    except (TypeError, ValueError):
+        raise SQLError(f"invalid timestamp {v.ts!r} in tuple value")
 
 
 class SQLEngine:
@@ -331,6 +346,7 @@ class SQLEngine:
 
         setacc: Dict[str, dict] = {}
         valacc: Dict[str, dict] = {}
+        quantum = []  # (field, col, QuantumSet): timestamped writes
         lonely = []  # records whose every field is NULL/empty: exists-only
         for rec in records:
             c = ckey(rec)
@@ -340,6 +356,14 @@ class SQLEngine:
                     continue
                 field = idx.field(name)
                 t = field.options.type
+                if isinstance(v, QuantumSet):
+                    _validate_quantum(name, t, v)
+                    if not v.values:
+                        continue  # empty set at a timestamp: no bits —
+                        # the record still rides the lonely/_exists path
+                    quantum.append((name, c, v))
+                    any_field = True
+                    continue
                 if t.is_bsi:
                     a = valacc.setdefault(name, {"cols": [], "values": []})
                     a["cols"].append(c)
@@ -380,6 +404,19 @@ class SQLEngine:
         if lonely and idx.options.track_existence:
             self.api.import_bits(idx.name, "_exists",
                                  rows=[0] * len(lonely), **colkw(lonely))
+        if quantum:
+            # Timestamped set writes route through PQL Set(col, f=v, ts)
+            # so views land per quantum AND the write fans out correctly
+            # on a cluster (reference: quantum inserts land per-view,
+            # field.go:1001 viewsByTime).
+            from pilosa_tpu.pql.ast import Call, Query
+
+            calls = []
+            for name, c, qs in quantum:
+                for item in qs.values:
+                    calls.append(Call("Set", {
+                        "_col": c, name: item, "_timestamp": qs.ts}))
+            self.api.query(idx.name, Query(calls))
 
     def _upsert_record(self, idx, values: dict, replace: bool = False) -> None:
         """Write one record THROUGH the api import surface so DML routes
@@ -402,6 +439,22 @@ class SQLEngine:
         for name, v in set_fields:
             field = idx.field(name)
             t = field.options.type
+            if isinstance(v, QuantumSet):
+                # timestamped write (same PQL Set lowering as the batch
+                # path; REPLACE resets the standard view first below via
+                # the quantum field's plain-set branch semantics)
+                _validate_quantum(name, t, v)
+                if not v.values:
+                    continue
+                from pilosa_tpu.pql.ast import Call, Query
+
+                c = str(raw_id) if idx.options.keys else int(raw_id)
+                self.api.query(index, Query([
+                    Call("Set", {"_col": c, name: item,
+                                 "_timestamp": v.ts})
+                    for item in v.values]))
+                imported = True
+                continue
             if t.is_bsi:
                 self.api.import_values(index, name, values=[v],
                                        **({"col_keys": col_keys}

@@ -30,7 +30,7 @@ KEYWORDS = {
 
 # multi-char operators first
 OPERATORS = ["<>", "!=", ">=", "<=", "=", "<", ">", "(", ")", ",", "*", "+",
-             "-", "/", "%", "[", "]", ".", ";", "@"]
+             "-", "/", "%", "[", "]", "{", "}", ".", ";", "@"]
 
 
 @dataclasses.dataclass

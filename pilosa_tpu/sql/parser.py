@@ -560,6 +560,17 @@ class Parser:
         if self.at_kw("NULL"):
             self.next()
             return ast.Literal(None)
+        if self.at_op("{"):
+            # tuple literal {ts, [vals]} — quantum insert values
+            # (reference: sql3 tuple literals, defs_timequantum.go)
+            self.next()
+            items = []
+            if not self.at_op("}"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+            self.expect_op("}")
+            return ast.TupleLiteral(items=items)
         if self.at_op("["):  # set literal ['a','b'] (bulk/insert values)
             self.next()
             items = []

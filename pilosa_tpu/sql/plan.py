@@ -81,6 +81,18 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$", re.IGNORECASE)
 
 
+class QuantumSet:
+    """A {timestamp, set} insert value for a time-quantum field
+    (reference: sql3 tuple(stringset) literals, defs_timequantum.go)."""
+
+    def __init__(self, ts: str, values: list):
+        self.ts = ts
+        self.values = values
+
+    def __repr__(self):
+        return f"QuantumSet({self.ts!r}, {self.values!r})"
+
+
 def eval_expr(expr: ast.Expr, env: Dict[str, Any]) -> Any:
     """Evaluate an expression against a row environment (column -> value).
 
@@ -167,6 +179,14 @@ def eval_expr(expr: ast.Expr, env: Dict[str, Any]) -> Any:
         return (not hit) if expr.negated else hit
     if isinstance(expr, ast.FuncCall):
         return _eval_func(expr, env)
+    if isinstance(expr, ast.TupleLiteral):
+        vals = [eval_expr(i, env) for i in expr.items]
+        if len(vals) == 2 and isinstance(vals[0], str) \
+                and isinstance(vals[1], list):
+            return QuantumSet(vals[0], vals[1])
+        raise SQLError(
+            "a tuple literal must be {timestamp, set} (quantum value); "
+            f"got {len(vals)} element(s)")
     raise SQLError(f"cannot evaluate {type(expr).__name__} on the host")
 
 
@@ -209,6 +229,9 @@ def _eval_func(f: ast.FuncCall, env: Dict[str, Any]) -> Any:
         # surfaces as a SQL error, never a bare Python exception (HTTP
         # would 500 on those)
         raise SQLError(f"{name.lower()}: {e}")
+    if name == "RANGEQ":
+        raise SQLError(
+            "rangeq() is only supported as a WHERE predicate")
     raise SQLError(f"unknown function {name}")
 
 

@@ -472,6 +472,37 @@ class Planner:
         return Call("Union", children=rows)
 
     def _lower_func(self, idx: Index, e: ast.FuncCall) -> Call:
+        if e.name == "RANGEQ":
+            # rangeq(quantum_col, from[, to]): records with ANY event in
+            # the range (reference: defs_timequantum.go; lowers to a
+            # view-ranged UnionRows over the covering quantum views)
+            if not e.args or not isinstance(e.args[0], ast.ColumnRef):
+                raise SQLError(
+                    "rangeq() requires a time-quantum column as its "
+                    "first argument")
+            fld = idx.field(e.args[0].name)
+            if fld.options.type != FieldType.TIME:
+                raise SQLError(
+                    f"rangeq() column {fld.name!r} is not a time-quantum "
+                    "field")
+            bounds = [_literal(a) for a in e.args[1:3]]
+            args = {"_field": fld.name}
+            import datetime as _dt
+            for key, b in zip(("from", "to"), bounds):
+                if b is None:
+                    continue
+                # a bad bound must be a SQL error, not a bare ValueError
+                # from the executor (HTTP 500); the executor parses ISO
+                # strings only
+                try:
+                    if not isinstance(b, str):
+                        raise ValueError
+                    _dt.datetime.fromisoformat(b.replace("Z", "+00:00"))
+                except ValueError:
+                    raise SQLError(
+                        f"rangeq() bound {b!r} is not a timestamp")
+                args[key] = b
+            return Call("UnionRows", children=[Call("Rows", args)])
         if e.name in ("SETCONTAINS", "SETCONTAINSANY", "SETCONTAINSALL"):
             if not isinstance(e.args[0], ast.ColumnRef):
                 raise CannotLower(e.name)

@@ -461,3 +461,54 @@ class TestDialectTail:
         api.sql("create table t (_id id, d decimal(6))")
         api.sql(txt)
         assert api.sql("select d from t").data == [[1e-06]]
+
+
+class TestQuantumEdges:
+    """Round-5 review findings on the quantum SQL surface."""
+
+    def _mk(self):
+        api = API()
+        api.sql("create table q (_id id, ids1 idsetq timequantum 'YMD', "
+                "ss1 stringsetq timequantum 'YMD')")
+        return api
+
+    def test_replace_with_tuple_value(self):
+        api = self._mk()
+        api.sql("replace into q (_id, ids1) values "
+                "(1, {'2022-01-02T00:00:00Z', [5]})")
+        assert api.sql(
+            "select _id from q where rangeq(ids1, '2022-01-01T00:00:00Z',"
+            " '2022-02-01T00:00:00Z')").data == [[1]]
+        # and no repr-garbage row keys were written
+        api2 = self._mk()
+        api2.sql("replace into q (_id, ss1) values "
+                 "(1, {'2022-01-02T00:00:00Z', ['a']})")
+        rows = api2.query("q", "Rows(ss1)")[0]
+        assert rows == ["a"], rows
+
+    def test_empty_tuple_keeps_record_alive(self):
+        api = self._mk()
+        api.sql("insert into q (_id, ids1) values "
+                "(3, {'2022-01-02T00:00:00Z', []})")
+        assert api.sql("select count(*) from q").data == [[1]]
+
+    def test_ranged_unionrows_honors_limit(self):
+        api = self._mk()
+        api.sql("insert into q (_id, ids1) values "
+                "(1, {'2022-01-02T00:00:00Z', [1]}), "
+                "(2, {'2022-01-03T00:00:00Z', [2]})")
+        full = api.query(
+            "q", "Count(UnionRows(Rows(ids1, from='2022-01-01T00:00:00Z',"
+            " to='2022-02-01T00:00:00Z')))")[0]
+        limited = api.query(
+            "q", "Count(UnionRows(Rows(ids1, from='2022-01-01T00:00:00Z',"
+            " to='2022-02-01T00:00:00Z', limit=1)))")[0]
+        assert full == 2 and limited == 1
+
+    def test_rangeq_bad_bound_is_sql_error(self):
+        from pilosa_tpu.sql.lexer import SQLError
+
+        api = self._mk()
+        for bad in ("'garbage'", "123"):
+            with pytest.raises(SQLError, match="not a timestamp"):
+                api.sql(f"select _id from q where rangeq(ids1, {bad})")

@@ -69,6 +69,13 @@ SETUP = [
     "create table subq (_id id, an_int int, a_string string)",
     "insert into subq values (1, 10, 'str1'), (2, 20, 'str1'), "
     "(3, 30, 'str2'), (4, 40, 'str3')",
+    # defs_timequantum.go model (time_quantum_insert)
+    "create table tqi (_id id, i1 int, ss1 stringsetq timequantum 'YMD', "
+    "ids1 idsetq timequantum 'YMD')",
+    "insert into tqi (_id, i1, ss1, ids1) values "
+    "(1, 1, {'2022-01-02T00:00:00Z', ['a']}, {'2022-01-02T00:00:00Z', [1]})",
+    "insert into tqi (_id, i1, ss1, ids1) values "
+    "(2, 2, {'2022-03-05T00:00:00Z', ['b']}, [9])",
 ]
 
 # (name, sql, expected rows, ordered)
@@ -376,6 +383,24 @@ CASES = [
      "select max(total) from (select sum(an_int) as total from "
      "(select a_string, an_int from subq) x group by a_string) y",
      [[40]], False),
+    # -- time quantum (defs_timequantum.go: rangeq + tuple inserts) --------
+    ("tq-rangeq-window",
+     "select _id from tqi where rangeq(ss1, '2022-01-01T00:00:00Z', "
+     "'2022-02-01T00:00:00Z')", [[1]], False),
+    ("tq-rangeq-open-start",
+     "select _id from tqi where rangeq(ss1, null, "
+     "'2022-02-01T00:00:00Z')", [[1]], False),
+    ("tq-rangeq-open-end",
+     "select _id from tqi where rangeq(ss1, '2022-02-01T00:00:00Z', "
+     "null)", [[2]], False),
+    ("tq-rangeq-all",
+     "select _id from tqi where rangeq(ss1, null, null)",
+     [[1], [2]], False),
+    ("tq-rangeq-idset",
+     "select _id from tqi where rangeq(ids1, '2022-01-01T00:00:00Z', "
+     "'2022-02-01T00:00:00Z')", [[1]], False),
+    ("tq-plain-set-insert-visible",
+     "select _id from tqi where setcontains(ids1, 9)", [[2]], False),
     # -- multi-shard (cluster distribution) --------------------------------
     ("big-count", "select count(*) from big", [[4]], False),
     ("big-sum", "select sum(n) from big", [[10]], False),
