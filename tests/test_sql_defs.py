@@ -69,6 +69,10 @@ SETUP = [
     "create table subq (_id id, an_int int, a_string string)",
     "insert into subq values (1, 10, 'str1'), (2, 20, 'str1'), "
     "(3, 30, 'str2'), (4, 40, 'str3')",
+    # defs_set_functions.go model (selectwithsetliterals)
+    "create table setfn (_id id, event stringset, ievent idset)",
+    "insert into setfn values (1, ['POST','GET'], [100, 101]), "
+    "(2, ['GET'], [100]), (3, ['DELETE'], [102])",
     # defs_timequantum.go model (time_quantum_insert)
     "create table tqi (_id id, i1 int, ss1 stringsetq timequantum 'YMD', "
     "ids1 idsetq timequantum 'YMD')",
@@ -383,6 +387,25 @@ CASES = [
      "select max(total) from (select sum(an_int) as total from "
      "(select a_string, an_int from subq) x group by a_string) y",
      [[40]], False),
+    # -- set functions projected in the select list (defs_set_functions) ---
+    ("setfn-contains-proj",
+     "select _id, setcontains(event, 'POST') from setfn",
+     [[1, True], [2, False], [3, False]], False),
+    ("setfn-containsall-proj",
+     "select _id, setcontainsall(event, ['POST','GET']) from setfn",
+     [[1, True], [2, False], [3, False]], False),
+    ("setfn-containsany-proj",
+     "select _id, setcontainsany(event, ['POST','DELETE']) from setfn",
+     [[1, True], [2, False], [3, True]], False),
+    ("setfn-id-contains",
+     "select _id from setfn where setcontains(ievent, 101)",
+     [[1]], False),
+    ("setfn-id-any",
+     "select _id from setfn where setcontainsany(ievent, [101, 102])",
+     [[1], [3]], False),
+    ("setfn-literal-target",
+     "select _id, setcontains(['POST'], 'POST') from setfn where _id = 1",
+     [[1, True]], False),
     # -- time quantum (defs_timequantum.go: rangeq + tuple inserts) --------
     ("tq-rangeq-window",
      "select _id from tqi where rangeq(ss1, '2022-01-01T00:00:00Z', "
