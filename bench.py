@@ -314,6 +314,75 @@ def bench_config5(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 6 — concurrent QPS: 64 intersect-count queries, scheduler on/off
+# ---------------------------------------------------------------------------
+
+def bench_config6(device: str) -> None:
+    """64 concurrent Intersect+Count queries through the sched/ micro-
+    batcher vs the sequential path. Each query alone is dispatch-bound
+    (within ~2x of floor_ms), so the batcher's fused dispatches are where
+    the QPS headroom lives; results must stay bit-identical."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.api import API
+
+    rng = np.random.default_rng(6)
+    n = _n(1_000_000)
+    city = rng.integers(0, 50, n)
+    dev = rng.integers(0, 10, n)
+    api = API()
+    api.create_index("c6")
+    api.create_field("c6", "city")
+    api.create_field("c6", "device")
+    cols = np.arange(n)
+    api.import_bits("c6", "city", rows=city, cols=cols)
+    api.import_bits("c6", "device", rows=dev, cols=cols)
+
+    nq = 64
+    queries = [f"Count(Intersect(Row(city={i % 50}), Row(device={i % 10})))"
+               for i in range(nq)]
+    # numpy oracle: the bit-identical ground truth for BOTH paths
+    want = [int(np.sum((city == i % 50) & (dev == i % 10)))
+            for i in range(nq)]
+    api.query("c6", queries[0])  # warm: compile + upload planes
+
+    def timed(q):
+        t0 = time.perf_counter()
+        r = api.query("c6", q)[0]
+        return r, time.perf_counter() - t0
+
+    # scheduler OFF: the sequential baseline
+    t0 = time.perf_counter()
+    off = [timed(q) for q in queries]
+    off_wall = time.perf_counter() - t0
+    assert [r for r, _ in off] == want
+
+    # scheduler ON: all 64 in flight, coalesced into fused dispatches
+    api.enable_scheduler(window_ms=2.0, max_batch=nq)
+    try:
+        with ThreadPoolExecutor(nq) as pool:
+            t0 = time.perf_counter()
+            on = list(pool.map(timed, queries))
+            on_wall = time.perf_counter() - t0
+    finally:
+        api.disable_scheduler()
+    assert [r for r, _ in on] == want  # bit-identical under batching
+
+    off_lat = sorted(s for _, s in off)
+    on_lat = sorted(s for _, s in on)
+
+    def pct(lat, p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    qps_on, qps_off = nq / on_wall, nq / off_wall
+    _emit(f"c6_concurrent_qps_64q{SCALED} ({device})", qps_on, "qps",
+          qps_on / qps_off, qps_off=qps_off,
+          p50_ms=pct(on_lat, 0.5), p99_ms=pct(on_lat, 0.99),
+          p50_off_ms=pct(off_lat, 0.5), p99_off_ms=pct(off_lat, 0.99),
+          floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -459,6 +528,7 @@ _CONFIGS = {
     "2": bench_config2,
     "4": bench_config4,
     "5": bench_config5,
+    "6": bench_config6,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -43,6 +43,10 @@ class API:
         self.idalloc = IDAllocator(
             _os.path.join(path, "idalloc.jsonl") if path else None)
         self._sql_engine = None
+        # optional micro-batching scheduler over the executor (sched/);
+        # None = sequential path. Enabled via enable_scheduler / config
+        # scheduler_enabled — reads then coalesce into fused dispatches.
+        self.scheduler = None
         # optional structured query log (reference: server.go:792);
         # set via api.set_query_logger / config query_log_path
         self.query_logger = None
@@ -54,6 +58,37 @@ class API:
         from pilosa_tpu.obs.logger import QueryLogger
 
         self.query_logger = QueryLogger(path)
+
+    # -- scheduler (sched/: admission + micro-batching) --------------------
+
+    def enable_scheduler(self, config=None, **overrides):
+        """Route concurrent reads through a micro-batching scheduler
+        (amortizes the per-dispatch floor). ``config`` is a
+        pilosa_tpu.config.Config; kwargs override individual knobs
+        (window_ms, max_batch, max_queue, default_deadline_ms, clock,
+        registry)."""
+        from pilosa_tpu.sched import QueryScheduler
+
+        if self.scheduler is not None:
+            self.disable_scheduler()
+        if config is not None:
+            self.scheduler = QueryScheduler.from_config(
+                self.executor, config, **overrides)
+        else:
+            self.scheduler = QueryScheduler(self.executor, **overrides)
+        return self.scheduler
+
+    def disable_scheduler(self) -> None:
+        sched, self.scheduler = self.scheduler, None
+        if sched is not None:
+            sched.close()
+
+    def read_executor(self):
+        """The executor read-only plan nodes should use: the scheduling
+        facade when enabled, the raw executor otherwise."""
+        if self.scheduler is not None:
+            return self.scheduler.as_executor()
+        return self.executor
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -103,7 +138,9 @@ class API:
     # -- query (reference: api.go:209 Query) -------------------------------
 
     def query(self, index: str, pql: str,
-              shards: Optional[Sequence[int]] = None) -> List[Any]:
+              shards: Optional[Sequence[int]] = None,
+              priority: Optional[str] = None,
+              deadline_ms: Optional[float] = None) -> List[Any]:
         from pilosa_tpu.pql import parse
         from pilosa_tpu.pql.executor import has_write_calls
 
@@ -120,11 +157,18 @@ class API:
             # write-Tx half of Qcx); pure reads take no lock — they see
             # versioned stacked-cache snapshots, and stack *builds*
             # serialize against writers internally (core/stacked.py).
-            import contextlib
-
-            ctx = (self.txf.qcx() if has_write_calls(parsed)
-                   else contextlib.nullcontext())
-            with ctx:
+            sched = self.scheduler
+            if has_write_calls(parsed):
+                with self.txf.qcx():
+                    out = self.executor.execute(index, parsed, shards=shards)
+            elif sched is not None:
+                kw = {}
+                if priority is not None:
+                    kw["priority"] = priority
+                if deadline_ms is not None:
+                    kw["deadline_ms"] = deadline_ms
+                out = sched.execute(index, parsed, shards=shards, **kw)
+            else:
                 out = self.executor.execute(index, parsed, shards=shards)
             self.history.end(rec)
             if self.query_logger is not None:
@@ -168,8 +212,11 @@ class API:
                                       _time.monotonic() - t0, error=str(e))
             raise
 
-    def query_json(self, index: str, pql: str) -> dict:
-        results = [result_to_json(r) for r in self.query(index, pql)]
+    def query_json(self, index: str, pql: str,
+                   priority: Optional[str] = None,
+                   deadline_ms: Optional[float] = None) -> dict:
+        results = [result_to_json(r) for r in self.query(
+            index, pql, priority=priority, deadline_ms=deadline_ms)]
         return {"results": results}
 
     # -- bulk import (reference: api.go:1438 Import / ImportValue) ---------

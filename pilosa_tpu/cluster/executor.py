@@ -51,6 +51,10 @@ class ClusterExecutor:
         self._on_node_down = on_node_down or (lambda _id: None)
         self._live_fn = live_fn
         self.local = Executor(holder, remote=True)
+        # optional micro-batching scheduler over the LOCAL engine (sched/):
+        # set by ClusterNode.enable_scheduler; coordinator fan-out then
+        # coalesces its local shard groups with concurrent coordinators'
+        self.scheduler = None
         self.translator = ClusterTranslator(node_id, holder, client,
                                             snapshot_fn, live_fn=live_fn)
 
@@ -143,10 +147,18 @@ class ClusterExecutor:
         pql = call.to_pql()
         return self._fan_shards(
             idx.name, shards,
-            lambda s: self.local.execute(idx.name, Query([call]),
-                                         shards=s)[0],
+            lambda s: self._run_local_read(idx.name, call, s),
             lambda node, s: R.result_from_wire(
                 self.client.query_node(node, idx.name, pql, s)[0]))
+
+    def _run_local_read(self, index: str, call: Call,
+                        shards: Sequence[int]) -> Any:
+        """Local half of a read fan-out; rides the micro-batcher when one
+        is attached so concurrent coordinators share a dispatch."""
+        sched = self.scheduler
+        if sched is not None and call.name not in _WRITE_CALLS:
+            return sched.execute(index, Query([call]), shards=shards)[0]
+        return self.local.execute(index, Query([call]), shards=shards)[0]
 
     # -- SQL subtree fanout (reference: executionplanner.go:212-338) -------
 

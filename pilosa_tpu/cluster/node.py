@@ -187,15 +187,58 @@ class ClusterNode:
     # -- queries -----------------------------------------------------------
 
     def query(self, index: str, pql: str,
-              shards: Optional[Sequence[int]] = None) -> List[Any]:
+              shards: Optional[Sequence[int]] = None,
+              priority: Optional[str] = None,
+              deadline_ms: Optional[float] = None) -> List[Any]:
         q = parse(pql) if isinstance(pql, str) else pql
-        self._check_state(write=any(
-            c.name in _WRITE_CALLS for c in q.calls))
+        is_write = any(c.name in _WRITE_CALLS for c in q.calls)
+        self._check_state(write=is_write)
+        sched = self.executor.scheduler
+        if sched is not None and not is_write:
+            # one admission ticket per client query; the per-shard local
+            # kernels inside the fan-out micro-batch via the scheduler
+            kw = {}
+            if priority is not None:
+                kw["priority"] = priority
+            with sched.admit(**kw):
+                return self.executor.execute(index, q, shards=shards)
         return self.executor.execute(index, q, shards=shards)
 
-    def query_json(self, index: str, pql: str) -> dict:
-        return {"results": [result_to_json(r)
-                            for r in self.query(index, pql)]}
+    def query_json(self, index: str, pql: str,
+                   priority: Optional[str] = None,
+                   deadline_ms: Optional[float] = None) -> dict:
+        return {"results": [result_to_json(r) for r in self.query(
+            index, pql, priority=priority, deadline_ms=deadline_ms)]}
+
+    # -- scheduler (sched/): same surface as the plain API -----------------
+
+    @property
+    def scheduler(self):
+        return self.executor.scheduler
+
+    def enable_scheduler(self, config=None, **overrides):
+        """Attach a micro-batching scheduler over the node's LOCAL engine;
+        coordinator fan-outs then coalesce their local shard groups."""
+        from pilosa_tpu.sched import QueryScheduler
+
+        self.disable_scheduler()
+        if config is not None:
+            sched = QueryScheduler.from_config(
+                self.executor.local, config, **overrides)
+        else:
+            sched = QueryScheduler(self.executor.local, **overrides)
+        self.executor.scheduler = sched
+        return sched
+
+    def disable_scheduler(self) -> None:
+        sched, self.executor.scheduler = self.executor.scheduler, None
+        if sched is not None:
+            sched.close()
+
+    def read_executor(self):
+        """SQL read plans run against the cluster executor either way —
+        its local legs consult executor.scheduler themselves."""
+        return self.executor
 
     def query_remote(self, index: str, pql: str,
                      shards: Sequence[int]) -> List[dict]:

@@ -58,6 +58,13 @@ class Config:
     query_log_path: str = ""  # reference: server.go:792 query logger
     # dataframe (reference: --dataframe.enable; on by default here)
     dataframe_enable: bool = True
+    # query scheduler ([scheduler] section / PILOSA_TPU_SCHEDULER_*):
+    # micro-batches concurrent reads to amortize the per-dispatch floor
+    scheduler_enabled: bool = False
+    scheduler_window_ms: float = 0.5  # batching horizon per group
+    scheduler_max_batch: int = 64  # queries fused per dispatch
+    scheduler_max_queue: int = 1024  # admission bound (429 beyond)
+    scheduler_default_deadline_ms: float = 0.0  # <=0: no deadline
 
     # -- sources -----------------------------------------------------------
 

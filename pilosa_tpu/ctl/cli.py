@@ -41,6 +41,8 @@ def cmd_server(args) -> int:
     configure_logging(cfg.log_level, cfg.log_path or None)
     api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync)
     api.holder.checkpoint_bytes = cfg.checkpoint_bytes
+    if cfg.scheduler_enabled:
+        api.enable_scheduler(cfg)
     if cfg.query_log_path:
         api.set_query_logger(cfg.query_log_path)
     auth = None

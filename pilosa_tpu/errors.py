@@ -8,3 +8,14 @@ import them without cycles.
 class ClusterStateError(RuntimeError):
     """Operation not allowed in the current cluster state (reference:
     api.go:160-187 validAPIMethods gating)."""
+
+
+class AdmissionError(RuntimeError):
+    """Query rejected at admission: the scheduler queue is full (or the
+    scheduler is closed). Maps to HTTP 429 — shed load under overload
+    instead of queueing unboundedly."""
+
+
+class QueryDeadlineError(RuntimeError):
+    """Query missed its deadline (or was cancelled) while queued.
+    Maps to HTTP 408."""

@@ -39,6 +39,19 @@ METRIC_MESH_FALLBACK = "mesh_sharding_fallback_total"
 # rows received from peers by SQL subtree fanout (transfer accounting:
 # asserts reduced streams, not whole tables, cross the wire)
 METRIC_SQL_FANOUT_ROWS = "sql_fanout_rows_total"
+# query scheduler (sched/): micro-batching health
+METRIC_SCHED_QUEUE_DEPTH = "sched_queue_depth"
+METRIC_SCHED_INFLIGHT = "sched_inflight"
+METRIC_SCHED_BATCH_SIZE = "sched_batch_size"  # histogram
+METRIC_SCHED_BATCH_WAIT = "sched_batch_wait_seconds"
+METRIC_SCHED_DISPATCH = "sched_dispatch_seconds"
+METRIC_SCHED_AMORTIZED_DISPATCH = "sched_amortized_dispatch_seconds"
+METRIC_SCHED_REJECTED = "sched_rejected_total"
+METRIC_SCHED_DEADLINE_MISS = "sched_deadline_missed_total"
+METRIC_SCHED_BATCHES = "sched_batches_total"
+METRIC_SCHED_QUERIES = "sched_queries_total"
+# batch-size buckets: powers of two up to the default max_batch
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
@@ -54,6 +67,8 @@ class MetricsRegistry:
         self._counters: Dict[_Key, float] = {}
         self._gauges: Dict[_Key, float] = {}
         self._summaries: Dict[_Key, Tuple[int, float]] = {}
+        # histogram: [buckets, per-bucket counts (+overflow), sum, count]
+        self._histograms: Dict[_Key, list] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[dict]) -> _Key:
@@ -73,6 +88,34 @@ class MetricsRegistry:
         with self._lock:
             c, s = self._summaries.get(k, (0, 0.0))
             self._summaries[k] = (c + 1, s + seconds)
+
+    def observe_bucketed(self, name: str, value: float,
+                         buckets: Tuple[float, ...], **labels) -> None:
+        """Histogram observation with explicit upper bounds (Prometheus
+        ``le`` semantics: a value lands in the first bucket whose bound
+        is >= value; beyond the last bound it only counts toward +Inf).
+        The bucket layout is fixed by the first observation of a series."""
+        import bisect
+
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                bs = tuple(sorted(float(b) for b in buckets))
+                h = [bs, [0] * (len(bs) + 1), 0.0, 0]
+                self._histograms[k] = h
+            h[1][bisect.bisect_left(h[0], value)] += 1
+            h[2] += value
+            h[3] += 1
+
+    def histogram(self, name: str, **labels) -> Optional[dict]:
+        """Snapshot of one histogram series (None if never observed)."""
+        with self._lock:
+            h = self._histograms.get(self._key(name, labels))
+            if h is None:
+                return None
+            return {"buckets": dict(zip(h[0], h[1])), "sum": h[2],
+                    "count": h[3]}
 
     def timer(self, name: str, **labels):
         """Context manager observing wall time into a summary."""
@@ -108,6 +151,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._summaries.clear()
+            self._histograms.clear()
 
     # -- exposition --------------------------------------------------------
 
@@ -134,6 +178,19 @@ class MetricsRegistry:
                 lbl = self._fmt_labels(labels)
                 out.append(f"{ns}_{name}_count{lbl} {c}")
                 out.append(f"{ns}_{name}_sum{lbl} {s}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                out.append(f"# TYPE {ns}_{name} histogram")
+                bs, counts, total, n = h
+                cum = 0
+                for ub, c in zip(bs, counts):
+                    cum += c
+                    lbl = self._fmt_labels(labels + (("le", f"{ub:g}"),))
+                    out.append(f"{ns}_{name}_bucket{lbl} {cum}")
+                lbl = self._fmt_labels(labels + (("le", "+Inf"),))
+                out.append(f"{ns}_{name}_bucket{lbl} {n}")
+                lbl = self._fmt_labels(labels)
+                out.append(f"{ns}_{name}_sum{lbl} {total}")
+                out.append(f"{ns}_{name}_count{lbl} {n}")
         return "\n".join(out) + "\n"
 
     def as_json(self) -> dict:
@@ -146,6 +203,14 @@ class MetricsRegistry:
                 "summaries": {
                     f"{n}{self._fmt_labels(l)}": {"count": c, "sum": s}
                     for (n, l), (c, s) in self._summaries.items()
+                },
+                "histograms": {
+                    f"{n}{self._fmt_labels(l)}": {
+                        "buckets": {f"{ub:g}": c
+                                    for ub, c in zip(h[0], h[1])},
+                        "overflow": h[1][-1], "sum": h[2], "count": h[3],
+                    }
+                    for (n, l), h in self._histograms.items()
                 },
             }
 

@@ -102,6 +102,16 @@ def _concat(parts, axis=0):
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=axis)
 
 
+def _start_copies(raw) -> None:
+    for r in raw:
+        if isinstance(r, _Deferred):
+            for a in r.arrays:
+                try:
+                    a.copy_to_host_async()
+                except AttributeError:  # non-array leaf
+                    pass
+
+
 class Executor:
     """Reference: executor.go:55 (executor struct).
 
@@ -149,14 +159,44 @@ class Executor:
     def _execute_query(self, idx: Index, query: Query, shards) -> List[Any]:
         raw = [self._execute_call(idx, call, shards) for call in query.calls]
         # Overlap all device->host copies, then block once.
-        for r in raw:
-            if isinstance(r, _Deferred):
-                for a in r.arrays:
-                    try:
-                        a.copy_to_host_async()
-                    except AttributeError:  # non-array leaf
-                        pass
+        _start_copies(raw)
         return [_resolve(r) for r in raw]
+
+    def execute_many(self, index: str, queries: Sequence,
+                     shards: Optional[Sequence[int]] = None
+                     ) -> List[List[Any]]:
+        """Resolve several read queries with ONE blocking device->host
+        sync — the fusion primitive behind the micro-batcher (sched/):
+        every call of every query dispatches asynchronously, then all
+        copies overlap, so N concurrent queries pay one round-trip floor
+        exactly like N top-level calls of a single ``execute``."""
+        from pilosa_tpu.core.stacked import StackStale
+
+        idx = self.holder.index(index)
+        qs: List[Query] = []
+        for q in queries:
+            if isinstance(q, str):
+                q = parse(q)
+            if isinstance(q, Call):
+                q = Query([q])
+            if has_write_calls(q):
+                raise ValueError("execute_many is read-only")
+            qs.append(q)
+        for _ in range(3):
+            try:
+                return self._execute_many(idx, qs, shards)
+            except StackStale:
+                continue
+        with self.holder.write_lock:
+            return self._execute_many(idx, qs, shards)
+
+    def _execute_many(self, idx: Index, qs: Sequence[Query],
+                      shards) -> List[List[Any]]:
+        raw = [[self._execute_call(idx, call, shards) for call in q.calls]
+               for q in qs]
+        for rq in raw:
+            _start_copies(rq)
+        return [[_resolve(r) for r in rq] for rq in raw]
 
     # -- dispatch (reference: executor.go:679 executeCall) --------------------
 

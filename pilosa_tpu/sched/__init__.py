@@ -1,0 +1,31 @@
+"""Query admission & micro-batching scheduler.
+
+Every query shape pays a fixed per-dispatch TPU floor (~67 ms tunneled,
+BENCH_r05 ``floor_ms``) that dwarfs the bitmap math; the c3 pallas
+kernel amortizes from 72.8 ms to 5.7 ms when work is batched. This
+package amortizes that floor across *concurrent queries*: reads queue in
+a bounded admission queue, a worker groups arrivals by compatible shape
+(same index / shard set / op family) within a short window, and each
+group executes as ONE fused executor dispatch whose results scatter back
+to the waiting callers (the continuous-batching insight of TPU-scale
+serving, arXiv:2112.09017, applied to bulk-bitwise analytics,
+arXiv:2302.01675).
+
+Layout:
+    scheduler.py  admission queue, priorities, deadlines, worker loop
+    batch.py      shape keys + fused batch execution / result scatter
+    clock.py      injectable time sources (deterministic tests)
+"""
+
+from pilosa_tpu.sched.batch import GroupKey, execute_batch, group_key
+from pilosa_tpu.sched.clock import ManualClock, MonotonicClock
+from pilosa_tpu.sched.scheduler import (
+    PRIORITY_BATCH, PRIORITY_INTERACTIVE, QueryScheduler, ScheduledQuery,
+    SchedulingExecutor,
+)
+
+__all__ = [
+    "GroupKey", "ManualClock", "MonotonicClock", "PRIORITY_BATCH",
+    "PRIORITY_INTERACTIVE", "QueryScheduler", "ScheduledQuery",
+    "SchedulingExecutor", "execute_batch", "group_key",
+]

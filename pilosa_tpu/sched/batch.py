@@ -1,0 +1,124 @@
+"""Shape grouping + fused batch execution.
+
+A batch is a set of read queries that agree on (index, shard set, op
+family). A compatible group goes to the executor's ``execute_many``
+fusion primitive (pql/executor.py): every call of every query
+dispatches asynchronously, all device->host copies overlap, and the
+batch blocks ONCE, so N queries pay one dispatch floor instead of N.
+Executors without ``execute_many`` fall back to concatenating the
+top-level calls into one merged ``Query`` and scattering results back
+by call-offset span.
+
+The op-family split keeps batches shape-compatible (the reference for a
+later fully-vmapped fast path: a "count" batch is N identical
+plane-reduce kernels over the same stacked planes, ideal for stacking
+into one [N, words] reduce) and keeps latency classes apart — a cheap
+Count never waits behind a 100-row Extract scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from pilosa_tpu.pql.ast import Call, Query
+
+# Top-level call name -> op family. Families batch together; anything
+# unlisted (Extract/Apply/Arrow/Sort/... — wide, host-heavy results)
+# rides the catch-all "scan" family so it cannot stall cheap scalar
+# queries in the same window.
+_FAMILY = {
+    "Count": "count",
+    "Row": "bitmap", "Union": "bitmap", "Intersect": "bitmap",
+    "Difference": "bitmap", "Xor": "bitmap", "Not": "bitmap",
+    "All": "bitmap", "ConstRow": "bitmap", "UnionRows": "bitmap",
+    "Shift": "bitmap", "Distinct": "bitmap", "Limit": "bitmap",
+    "Sum": "agg", "Min": "agg", "Max": "agg", "Percentile": "agg",
+    "TopN": "rank", "TopK": "rank", "Rows": "rank", "GroupBy": "rank",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupKey:
+    """Everything two queries must agree on to share a dispatch. The
+    shard-width axis is a build-time constant (shardwidth.py), so index +
+    explicit shard set pin the stacked-plane shapes; the family pins the
+    kernel mix."""
+
+    index: str
+    shards: Optional[Tuple[int, ...]]
+    family: str
+
+
+def family_of(query: Query) -> str:
+    """Coarse op family of a (possibly multi-call) query; a mixed-family
+    query gets a composite key so identical mixes still batch."""
+    fams = []
+    for call in query.calls:
+        inner = call
+        while inner.name == "Options" and inner.children:
+            inner = inner.children[0]
+        f = _FAMILY.get(inner.name, "scan")
+        if f not in fams:
+            fams.append(f)
+    return "+".join(sorted(fams)) or "scan"
+
+
+def group_key(index: str, query: Query,
+              shards: Optional[Sequence[int]] = None) -> GroupKey:
+    return GroupKey(
+        index=index,
+        shards=tuple(sorted(int(s) for s in shards))
+        if shards is not None else None,
+        family=family_of(query),
+    )
+
+
+def execute_batch(executor, entries: List) -> None:
+    """Run one compatible group as a single fused dispatch and scatter
+    results. Each entry carries ``index``/``query``/``shards`` (equal
+    under the group key) and a ``future`` to complete.
+
+    Error isolation: a failing call inside a merged query would fail the
+    whole executor call, so on any batch-level exception the entries
+    re-run individually — a malformed query costs its batch-mates the
+    amortization on that one batch, never their results.
+    """
+    if not entries:
+        return
+    first = entries[0]
+    if len(entries) == 1:
+        _run_single(executor, first)
+        return
+    many = getattr(executor, "execute_many", None)
+    try:
+        if many is not None:
+            # native fusion primitive (pql/executor.py execute_many):
+            # per-query call lists stay intact, one blocking sync
+            per_query = many(first.index, [e.query for e in entries],
+                             shards=first.shards)
+        else:
+            # plain executors: concatenate calls into one merged Query
+            # and scatter by offset span
+            calls: List[Call] = []
+            spans: List[Tuple[int, int]] = []
+            for e in entries:
+                spans.append((len(calls), len(e.query.calls)))
+                calls.extend(e.query.calls)
+            results = executor.execute(first.index, Query(calls),
+                                       shards=first.shards)
+            per_query = [results[off:off + n] for off, n in spans]
+    except Exception:
+        for e in entries:
+            _run_single(executor, e)
+        return
+    for e, res in zip(entries, per_query):
+        e.future.set_result(res)
+
+
+def _run_single(executor, entry) -> None:
+    try:
+        entry.future.set_result(
+            executor.execute(entry.index, entry.query, shards=entry.shards))
+    except Exception as exc:
+        entry.future.set_exception(exc)

@@ -1,0 +1,333 @@
+"""Admission queue + micro-batching worker.
+
+One daemon worker drains a bounded queue: it picks the oldest highest-
+priority pending query, waits out the remainder of that query's batching
+window (new compatible arrivals pile in meanwhile), then takes every
+queued query with the same :class:`~pilosa_tpu.sched.batch.GroupKey` and
+dispatches the group fused. Backpressure is by rejection, not blocking —
+a full queue raises :class:`~pilosa_tpu.errors.AdmissionError`
+immediately (429 at the HTTP edge) so overload sheds load instead of
+growing latency unboundedly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+from typing import List, Optional, Sequence, Union
+
+from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
+from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.pql.ast import Call, Query
+from pilosa_tpu.pql.executor import has_write_calls
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.sched.batch import GroupKey, execute_batch, group_key
+from pilosa_tpu.sched.clock import MonotonicClock
+
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+_PRIORITY_RANK = {PRIORITY_INTERACTIVE: 0, PRIORITY_BATCH: 1}
+
+
+class _Pending:
+    __slots__ = ("index", "query", "shards", "priority", "rank", "deadline",
+                 "future", "enqueued", "seq", "key")
+
+    def __init__(self, index: str, query: Query,
+                 shards: Optional[Sequence[int]], priority: str,
+                 deadline: Optional[float], enqueued: float, seq: int):
+        self.index = index
+        self.query = query
+        self.shards = tuple(shards) if shards is not None else None
+        self.priority = priority
+        self.rank = _PRIORITY_RANK[priority]
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.enqueued = enqueued
+        self.seq = seq
+        self.key: GroupKey = group_key(index, query, shards)
+
+
+class ScheduledQuery:
+    """Caller-side handle: block on :meth:`result` or :meth:`cancel`."""
+
+    def __init__(self, pending: _Pending):
+        self._pending = pending
+
+    def result(self, timeout: Optional[float] = None) -> List:
+        try:
+            return self._pending.future.result(timeout)
+        except CancelledError:
+            raise QueryDeadlineError("query cancelled before dispatch")
+
+    def done(self) -> bool:
+        return self._pending.future.done()
+
+    def cancel(self) -> bool:
+        """Best-effort: succeeds only while still queued."""
+        return self._pending.future.cancel()
+
+
+class QueryScheduler:
+    """Bounded-admission micro-batcher over a PQL executor.
+
+    ``window_ms`` is the batching horizon: the worker holds the oldest
+    pending query at most this long so concurrent arrivals can join its
+    dispatch. 0 disables coalescing-by-time (still batches whatever is
+    queued at take time). ``default_deadline_ms`` ≤ 0 means no deadline.
+    """
+
+    def __init__(self, executor, *, window_ms: float = 0.5,
+                 max_batch: int = 64, max_queue: int = 1024,
+                 default_deadline_ms: float = 0.0, clock=None,
+                 registry=None):
+        self.executor = executor
+        self.window_s = max(0.0, float(window_ms)) / 1000.0
+        self.max_batch = max(1, int(max_batch))
+        self.max_queue = max(1, int(max_queue))
+        self.default_deadline_s = max(0.0, float(default_deadline_ms)) / 1e3
+        self.clock = clock if clock is not None else MonotonicClock()
+        self.registry = registry if registry is not None else (
+            obs_metrics.REGISTRY)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.clock.attach(self._cv)
+        self._queue: List[_Pending] = []
+        self._seq = 0
+        self._paused = False
+        self._closed = False
+        self._inflight_admits = 0
+        self._worker = threading.Thread(
+            target=self._loop, name="pilosa-sched", daemon=True)
+        self._worker.start()
+
+    @classmethod
+    def from_config(cls, executor, config, **overrides):
+        kw = dict(
+            window_ms=config.scheduler_window_ms,
+            max_batch=config.scheduler_max_batch,
+            max_queue=config.scheduler_max_queue,
+            default_deadline_ms=config.scheduler_default_deadline_ms,
+        )
+        kw.update(overrides)
+        return cls(executor, **kw)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, index: str, query: Union[str, Query, Call],
+               shards: Optional[Sequence[int]] = None,
+               priority: str = PRIORITY_INTERACTIVE,
+               deadline_ms: Optional[float] = None) -> ScheduledQuery:
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        if priority not in _PRIORITY_RANK:
+            raise ValueError(f"unknown priority: {priority!r}")
+        if has_write_calls(query):
+            raise ValueError(
+                "scheduler accepts read-only queries; execute writes "
+                "directly through API.query")
+        if deadline_ms is None:
+            deadline_s = self.default_deadline_s
+        else:
+            deadline_s = max(0.0, float(deadline_ms)) / 1e3
+        now = self.clock.now()
+        with self._cv:
+            if self._closed:
+                raise AdmissionError("scheduler is closed")
+            limit = self.max_queue
+            if priority == PRIORITY_BATCH:
+                # batch traffic may only fill half the queue, reserving
+                # headroom so interactive admits survive ingest storms
+                limit = max(1, self.max_queue // 2)
+            if len(self._queue) >= limit:
+                self.registry.count(obs_metrics.METRIC_SCHED_REJECTED,
+                                  priority=priority, reason="queue_full")
+                raise AdmissionError(
+                    f"admission queue full ({len(self._queue)} queued, "
+                    f"limit {limit} for priority={priority})")
+            pending = _Pending(
+                index, query, shards, priority,
+                now + deadline_s if deadline_s > 0 else None, now, self._seq)
+            self._seq += 1
+            self._queue.append(pending)
+            self.registry.gauge(obs_metrics.METRIC_SCHED_QUEUE_DEPTH,
+                                len(self._queue))
+            self._cv.notify_all()
+        return ScheduledQuery(pending)
+
+    def execute(self, index: str, query: Union[str, Query, Call],
+                shards: Optional[Sequence[int]] = None,
+                priority: str = PRIORITY_INTERACTIVE,
+                deadline_ms: Optional[float] = None) -> List:
+        """Drop-in for ``Executor.execute`` on reads: submit and wait.
+
+        Calls from the worker thread itself (a batched query whose
+        evaluation recurses into execute) and writes bypass the queue —
+        re-entrant submission would deadlock the single worker.
+        """
+        if threading.current_thread() is self._worker:
+            return self.executor.execute(index, query, shards=shards)
+        if isinstance(query, str):
+            query = parse(query)
+        elif isinstance(query, Call):
+            query = Query([query])
+        if has_write_calls(query):
+            return self.executor.execute(index, query, shards=shards)
+        return self.submit(index, query, shards, priority,
+                           deadline_ms).result()
+
+    @contextlib.contextmanager
+    def admit(self, priority: str = PRIORITY_INTERACTIVE):
+        """Admission-control-only ticket for work the batcher cannot fuse
+        (SQL scans): bounds concurrent admitted work by ``max_queue``
+        without routing execution through the queue."""
+        with self._cv:
+            if self._closed:
+                raise AdmissionError("scheduler is closed")
+            limit = self.max_queue
+            if priority == PRIORITY_BATCH:
+                limit = max(1, self.max_queue // 2)
+            if self._inflight_admits + len(self._queue) >= limit:
+                self.registry.count(obs_metrics.METRIC_SCHED_REJECTED,
+                                  priority=priority, reason="admit_full")
+                raise AdmissionError(
+                    f"admission limit reached ({self._inflight_admits} "
+                    f"inflight, limit {limit} for priority={priority})")
+            self._inflight_admits += 1
+            self.registry.gauge(obs_metrics.METRIC_SCHED_INFLIGHT,
+                                self._inflight_admits)
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._inflight_admits -= 1
+                self.registry.gauge(obs_metrics.METRIC_SCHED_INFLIGHT,
+                                    self._inflight_admits)
+
+    def as_executor(self) -> "SchedulingExecutor":
+        return SchedulingExecutor(self)
+
+    # -- worker ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                batch = self._next_batch_locked()
+                if batch is None:
+                    return
+            if batch:
+                self._dispatch(batch)
+
+    def _next_batch_locked(self) -> Optional[List[_Pending]]:
+        """Wait (held lock) until a group is ripe; take it. None = stop."""
+        while True:
+            if self._closed:
+                for p in self._queue:
+                    if p.future.set_running_or_notify_cancel():
+                        p.future.set_exception(
+                            AdmissionError("scheduler closed"))
+                self._queue.clear()
+                self.registry.gauge(obs_metrics.METRIC_SCHED_QUEUE_DEPTH, 0)
+                return None
+            if self._paused or not self._queue:
+                self._cv.wait()
+                continue
+            head = min(self._queue, key=lambda p: (p.rank, p.seq))
+            now = self.clock.now()
+            same = sum(1 for p in self._queue if p.key == head.key)
+            ripe = (same >= self.max_batch
+                    or now >= head.enqueued + self.window_s)
+            if not ripe:
+                self.clock.wait(self._cv, head.enqueued + self.window_s - now)
+                continue
+            return self._take_locked(head.key, now)
+
+    def _take_locked(self, key: GroupKey, now: float) -> List[_Pending]:
+        batch: List[_Pending] = []
+        keep: List[_Pending] = []
+        for p in self._queue:
+            if p.key != key or len(batch) >= self.max_batch:
+                keep.append(p)
+                continue
+            if not p.future.set_running_or_notify_cancel():
+                continue  # caller cancelled while queued
+            if p.deadline is not None and now > p.deadline:
+                self.registry.count(obs_metrics.METRIC_SCHED_DEADLINE_MISS,
+                                  priority=p.priority)
+                p.future.set_exception(QueryDeadlineError(
+                    f"deadline exceeded after "
+                    f"{(now - p.enqueued) * 1e3:.1f} ms in queue"))
+                continue
+            self.registry.observe(obs_metrics.METRIC_SCHED_BATCH_WAIT,
+                                  now - p.enqueued)
+            batch.append(p)
+        self._queue = keep
+        self.registry.gauge(obs_metrics.METRIC_SCHED_QUEUE_DEPTH, len(keep))
+        return batch
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        family = batch[0].key.family
+        t0 = time.perf_counter()
+        execute_batch(self.executor, batch)
+        elapsed = time.perf_counter() - t0
+        self.registry.observe_bucketed(
+            obs_metrics.METRIC_SCHED_BATCH_SIZE, len(batch),
+            obs_metrics.BATCH_SIZE_BUCKETS, family=family)
+        self.registry.observe(obs_metrics.METRIC_SCHED_DISPATCH, elapsed)
+        self.registry.observe(obs_metrics.METRIC_SCHED_AMORTIZED_DISPATCH,
+                              elapsed / len(batch))
+        self.registry.count(obs_metrics.METRIC_SCHED_BATCHES, family=family)
+        self.registry.count(obs_metrics.METRIC_SCHED_QUERIES, len(batch),
+                          family=family)
+
+    # -- control / test hooks ---------------------------------------------
+
+    def pause(self) -> None:
+        """Hold the worker so tests can stage a queue, then resume()."""
+        with self._cv:
+            self._paused = True
+            self._cv.notify_all()
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def wait_queued(self, n: int, timeout: float = 5.0) -> int:
+        """Spin (real time) until ≥ n entries are queued; test helper."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                depth = len(self._queue)
+            if depth >= n or time.monotonic() >= deadline:
+                return depth
+            time.sleep(0.0005)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+
+
+class SchedulingExecutor:
+    """Executor facade: ``execute`` routes reads through the scheduler;
+    everything else (qcx/holder attrs, write paths) proxies the wrapped
+    executor, so call sites built against ``Executor`` keep working."""
+
+    def __init__(self, scheduler: QueryScheduler):
+        self.scheduler = scheduler
+
+    def execute(self, index: str, query, shards=None):
+        return self.scheduler.execute(index, query, shards=shards)
+
+    def __getattr__(self, name):
+        return getattr(self.scheduler.executor, name)

@@ -29,7 +29,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from pilosa_tpu.api import API
-from pilosa_tpu.errors import ClusterStateError
+from pilosa_tpu.errors import (AdmissionError, ClusterStateError,
+                               QueryDeadlineError)
 
 _ROUTES = [
     # node-to-node endpoints (reference: http_handler.go:552-585 /internal/*)
@@ -252,6 +253,11 @@ class Handler(BaseHTTPRequestHandler):
                 except ClusterStateError as e:
                     # gated by cluster state (reference: api.go:160)
                     self._send(412, {"error": str(e)})
+                except AdmissionError as e:
+                    # scheduler backpressure: shed load, retryable
+                    self._send(429, {"error": str(e)})
+                except QueryDeadlineError as e:
+                    self._send(408, {"error": str(e)})
                 except Exception as e:  # pragma: no cover - last resort
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
@@ -287,6 +293,13 @@ class Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlsplit
 
         qs = parse_qs(urlsplit(self.path).query)
+        # scheduler hints (?priority=interactive|batch, ?timeout_ms=N);
+        # ignored when the scheduler is disabled
+        kw = {}
+        if qs.get("priority"):
+            kw["priority"] = qs["priority"][-1]
+        if qs.get("timeout_ms"):
+            kw["deadline_ms"] = float(qs["timeout_ms"][-1])
         if qs.get("profile", [""])[-1].lower() == "true":
             # per-query CPU profile (reference: http_handler.go:1301
             # DoPerQueryProfiling); top functions ride in the response
@@ -297,7 +310,7 @@ class Handler(BaseHTTPRequestHandler):
             prof = cProfile.Profile()
             prof.enable()
             try:
-                out = self.api.query_json(index, q)
+                out = self.api.query_json(index, q, **kw)
             finally:
                 prof.disable()
             s = _io.StringIO()
@@ -306,7 +319,7 @@ class Handler(BaseHTTPRequestHandler):
             out["profile"] = s.getvalue().splitlines()
             self._send(200, out)
             return
-        self._send(200, self.api.query_json(index, q))
+        self._send(200, self.api.query_json(index, q, **kw))
 
     def post_sql(self):
         """SQL query; body is the raw SQL text (reference:

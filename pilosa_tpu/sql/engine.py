@@ -88,8 +88,17 @@ class SQLEngine:
             if stmt.table in _SYSTEM_TABLES:
                 return self._system_table(stmt)
             self._reject_udf_calls(stmt)
-            op = self.planner.plan_select(stmt)
-            return SQLResult(schema=op.schema, data=[list(r) for r in op.rows()])
+            sched = getattr(self.api, "scheduler", None)
+            # admission ticket bounds concurrent SELECTs under overload
+            # (the kernel calls inside the plan still micro-batch via the
+            # planner's _read_executor facade)
+            import contextlib
+            admit = sched.admit() if sched is not None else (
+                contextlib.nullcontext())
+            with admit:
+                op = self.planner.plan_select(stmt)
+                return SQLResult(schema=op.schema,
+                                 data=[list(r) for r in op.rows()])
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateView):

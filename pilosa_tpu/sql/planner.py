@@ -57,6 +57,14 @@ class Planner:
 
         self._expanding_local = _threading.local()
 
+    def _read_executor(self):
+        """Executor for read-only plan nodes: the scheduling facade when
+        the api has one enabled (micro-batches concurrent SELECT kernels),
+        else the raw executor. Resolved per-plan so enabling/disabling the
+        scheduler at runtime affects subsequent queries."""
+        fn = getattr(self.api, "read_executor", None)
+        return fn() if fn is not None else self.api.executor
+
     # -- entry ---------------------------------------------------------------
 
     def plan_select(self, s: ast.SelectStatement) -> PlanOp:
@@ -278,7 +286,7 @@ class Planner:
         fields = [idx.field(f) for f in field_names]
         schema: Schema = [("_id", id_sql_type(idx.options.keys))]
         schema += [(f.name, field_to_sql_type(f.options)) for f in fields]
-        executor = self.api.executor
+        executor = self._read_executor()
 
         def thunk():
             call = Call("Extract",
@@ -534,7 +542,7 @@ class Planner:
         filter_call, host_pred = self._split_filter(idx, s.where)
         if host_pred is not None:
             return self._plan_host_aggregate(idx, s, items, aggs, ctx)
-        executor = self.api.executor
+        executor = self._read_executor()
         agg_names = self._name_aggs(aggs, ctx)
         hidden = self._hidden_agg_items(idx, items, aggs, s.order_by, ctx)
         schema = [(self._item_name(it, i), self._item_type(idx, it.expr))
@@ -583,7 +591,7 @@ class Planner:
                  filter_call: Optional[Call]) -> Any:
         """One aggregate -> one PQL call (reference:
         sql3/planner/oppqlaggregate.go + planoptimizer aggregate fusion)."""
-        executor = self.api.executor
+        executor = self._read_executor()
 
         def run(call: Call):
             return executor.execute(idx.name, Query([call]))[0]
@@ -687,7 +695,7 @@ class Planner:
                           ctx: _QueryCtx) -> PlanOp:
         """GroupBy on the kernel engine (reference:
         sql3/planner/oppqlgroupby.go + oppqlmultigroupby fusion)."""
-        executor = self.api.executor
+        executor = self._read_executor()
         agg_names = self._name_aggs(aggs, ctx)
         hidden = self._hidden_agg_items(idx, items, aggs, s.order_by, ctx)
         sum_col = next((a.args[0].name for a in aggs if a.name == "SUM"), None)

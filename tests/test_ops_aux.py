@@ -32,6 +32,62 @@ class TestMetrics:
         assert 'pilosa_maximum_shard{index="i"} 5' in text
         assert 'pilosa_http_request_duration_seconds_count{route="q"} 1' in text
 
+    def test_bucketed_histograms(self):
+        r = MetricsRegistry()
+        buckets = (1.0, 2.0, 4.0)
+        for v in (1, 1, 3, 100):
+            r.observe_bucketed("sched_batch_size", v, buckets,
+                               family="count")
+        text = r.prometheus_text()
+        # cumulative counts, le formatted last after sorted labels
+        assert "# TYPE pilosa_sched_batch_size histogram" in text
+        assert 'pilosa_sched_batch_size_bucket{family="count",le="1"} 2' \
+            in text
+        assert 'pilosa_sched_batch_size_bucket{family="count",le="2"} 2' \
+            in text
+        assert 'pilosa_sched_batch_size_bucket{family="count",le="4"} 3' \
+            in text
+        assert 'pilosa_sched_batch_size_bucket{family="count",le="+Inf"} 4' \
+            in text
+        assert 'pilosa_sched_batch_size_sum{family="count"} 105' in text
+        assert 'pilosa_sched_batch_size_count{family="count"} 4' in text
+        j = r.as_json()["histograms"]['sched_batch_size{family="count"}']
+        assert j["buckets"] == {"1": 2, "2": 0, "4": 1}
+        assert j["overflow"] == 1
+        assert j["count"] == 4
+        snap = r.histogram("sched_batch_size", family="count")
+        assert snap["count"] == 4 and snap["sum"] == 105
+
+    def test_scheduler_metrics_flow_through_exposition(self):
+        from pilosa_tpu.api import API as _API
+        from pilosa_tpu.obs import metrics as M
+
+        r = MetricsRegistry()
+        api = _API()
+        api.create_index("sm")
+        api.create_field("sm", "f")
+        api.query("sm", "Set(1, f=1)Set(2, f=1)")
+        sched = api.enable_scheduler(window_ms=0, registry=r)
+        try:
+            sched.pause()
+            hs = [sched.submit("sm", "Count(Row(f=1))") for _ in range(3)]
+            assert sched.wait_queued(3) == 3
+            sched.resume()
+            for h in hs:
+                assert h.result(timeout=5) == [2]
+        finally:
+            api.disable_scheduler()
+        assert r.value(M.METRIC_SCHED_QUERIES, family="count") == 3
+        assert r.value(M.METRIC_SCHED_BATCHES, family="count") == 1
+        text = r.prometheus_text()
+        assert 'pilosa_sched_batch_size_bucket{family="count",le="4"} 1' \
+            in text
+        assert 'pilosa_sched_queries_total{family="count"} 3' in text
+        assert "pilosa_sched_batch_wait_seconds_count" in text
+        assert "pilosa_sched_amortized_dispatch_seconds_sum" in text
+        j = r.as_json()
+        assert 'sched_batch_size{family="count"}' in j["histograms"]
+
     def test_api_instruments(self):
         base = REGISTRY.value("pql_queries_total")
         api = API()

@@ -1,0 +1,428 @@
+"""Query admission & micro-batching scheduler (pilosa_tpu/sched/).
+
+All concurrency here is event-driven — pause()/resume() stage the queue,
+ManualClock drives windows and deadlines — so the tests are deterministic
+under JAX_PLATFORMS=cpu with no real-time sleeps.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
+from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.obs.metrics import MetricsRegistry
+from pilosa_tpu.pql.result import result_to_json
+from pilosa_tpu.sched import (
+    ManualClock, PRIORITY_BATCH, QueryScheduler, group_key,
+)
+from pilosa_tpu.sched.batch import family_of
+from pilosa_tpu.pql.parser import parse
+
+
+class StubExecutor:
+    """Records every execute(); each call's 'result' is its own PQL text,
+    so scatter bugs (wrong offsets, swapped entries) surface as wrong
+    strings."""
+
+    def __init__(self, fail_when=None):
+        self.calls = []
+        self.fail_when = fail_when or (lambda q: False)
+        self._lock = threading.Lock()
+
+    def execute(self, index, query, shards=None):
+        with self._lock:
+            self.calls.append((index, [c.name for c in query.calls], shards))
+        if self.fail_when(query):
+            raise RuntimeError("stub failure")
+        return [c.to_pql() for c in query.calls]
+
+
+@pytest.fixture
+def make_sched():
+    created = []
+
+    def make(executor, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        s = QueryScheduler(executor, **kw)
+        created.append(s)
+        return s
+
+    yield make
+    for s in created:
+        s.close()
+
+
+class TestGroupKey:
+    def test_families(self):
+        assert family_of(parse("Count(Row(f=1))")) == "count"
+        assert family_of(parse("Intersect(Row(f=1), Row(g=2))")) == "bitmap"
+        assert family_of(parse("Sum(field=v)")) == "agg"
+        assert family_of(parse("TopN(f)")) == "rank"
+        assert family_of(parse("Extract(All(), Rows(f))")) == "scan"
+        # multi-call queries get a composite (order-insensitive) family
+        two = parse("Count(Row(f=1))Row(g=2)")
+        assert family_of(two) == "bitmap+count"
+
+    def test_key_compatibility(self):
+        q = parse("Count(Row(f=1))")
+        assert group_key("i", q, [2, 1]) == group_key("i", q, [1, 2])
+        assert group_key("i", q) != group_key("j", q)
+        assert group_key("i", q) != group_key("i", parse("Row(f=1)"))
+
+
+class TestBatching:
+    def test_staged_queries_fuse_into_one_dispatch(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64)
+        s.pause()
+        handles = [s.submit("i", f"Count(Row(f={k}))") for k in range(8)]
+        assert s.wait_queued(8) == 8
+        s.resume()
+        results = [h.result(timeout=5) for h in handles]
+        # every caller got its OWN query's result back
+        assert results == [[f"Count(Row(f={k}))"] for k in range(8)]
+        assert len(stub.calls) == 1  # one fused dispatch
+        assert stub.calls[0][1] == ["Count"] * 8
+
+    def test_incompatible_shapes_split(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64)
+        s.pause()
+        a = s.submit("i", "Count(Row(f=1))")
+        b = s.submit("i", "Row(f=1)")          # different family
+        c = s.submit("j", "Count(Row(f=1))")   # different index
+        assert s.wait_queued(3) == 3
+        s.resume()
+        for h in (a, b, c):
+            h.result(timeout=5)
+        assert len(stub.calls) == 3
+
+    def test_max_batch_cap(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=3)
+        s.pause()
+        handles = [s.submit("i", f"Count(Row(f={k}))") for k in range(7)]
+        assert s.wait_queued(7) == 7
+        s.resume()
+        for h in handles:
+            h.result(timeout=5)
+        assert sorted(len(names) for _, names, _ in stub.calls) == [1, 3, 3]
+
+    def test_window_fires_via_manual_clock(self, make_sched):
+        stub = StubExecutor()
+        clock = ManualClock()
+        s = make_sched(stub, window_ms=5, max_batch=64, clock=clock)
+        h = s.submit("i", "Count(Row(f=1))")
+        assert s.wait_queued(1) == 1  # parked: window not elapsed
+        assert not h.done()
+        clock.advance(0.006)
+        assert h.result(timeout=5) == ["Count(Row(f=1))"]
+
+    def test_batch_size_cap_flushes_without_clock(self, make_sched):
+        stub = StubExecutor()
+        clock = ManualClock()  # time NEVER advances
+        s = make_sched(stub, window_ms=1000, max_batch=2, clock=clock)
+        a = s.submit("i", "Count(Row(f=1))")
+        b = s.submit("i", "Count(Row(f=2))")
+        # size cap alone must trigger the flush
+        assert a.result(timeout=5) and b.result(timeout=5)
+
+
+class TestAdmission:
+    def test_queue_full_rejects_with_admission_error(self, make_sched):
+        stub = StubExecutor()
+        reg = MetricsRegistry()
+        s = make_sched(stub, window_ms=0, max_queue=2, registry=reg)
+        s.pause()
+        s.submit("i", "Count(Row(f=1))")
+        s.submit("i", "Count(Row(f=2))")
+        with pytest.raises(AdmissionError):
+            s.submit("i", "Count(Row(f=3))")
+        assert reg.value(M.METRIC_SCHED_REJECTED, priority="interactive",
+                         reason="queue_full") == 1
+        s.resume()
+
+    def test_batch_priority_has_tighter_limit(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0, max_queue=4)
+        s.pause()
+        s.submit("i", "Count(Row(f=1))", priority=PRIORITY_BATCH)
+        s.submit("i", "Count(Row(f=2))", priority=PRIORITY_BATCH)
+        with pytest.raises(AdmissionError):  # batch capped at max_queue//2
+            s.submit("i", "Count(Row(f=3))", priority=PRIORITY_BATCH)
+        # interactive still has headroom up to max_queue
+        s.submit("i", "Count(Row(f=4))")
+        s.resume()
+
+    def test_interactive_dispatches_before_batch(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64)
+        s.pause()
+        # batch-priority submitted FIRST, to a different group key
+        b = s.submit("bulk", "Count(Row(f=1))", priority=PRIORITY_BATCH)
+        a = s.submit("live", "Count(Row(f=1))")
+        assert s.wait_queued(2) == 2
+        s.resume()
+        a.result(timeout=5)
+        b.result(timeout=5)
+        assert [c[0] for c in stub.calls] == ["live", "bulk"]
+
+    def test_writes_refused(self, make_sched):
+        s = make_sched(StubExecutor(), window_ms=0)
+        with pytest.raises(ValueError):
+            s.submit("i", "Set(1, f=2)")
+
+    def test_execute_bypasses_queue_for_writes(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0)
+        s.pause()  # queue frozen — a queued write would hang
+        assert s.execute("i", "Set(1, f=2)") == ["Set(1, f=2)"]
+        s.resume()
+
+    def test_closed_scheduler_rejects(self, make_sched):
+        s = make_sched(StubExecutor(), window_ms=0)
+        s.close()
+        with pytest.raises(AdmissionError):
+            s.submit("i", "Count(Row(f=1))")
+
+    def test_admit_ticket_bounds_inflight(self, make_sched):
+        s = make_sched(StubExecutor(), window_ms=0, max_queue=1)
+        with s.admit():
+            with pytest.raises(AdmissionError):
+                with s.admit():
+                    pass
+        with s.admit():  # released tickets free capacity
+            pass
+
+
+class TestDeadlines:
+    def test_expired_deadline_fails_without_poisoning_batch(self, make_sched):
+        stub = StubExecutor()
+        reg = MetricsRegistry()
+        clock = ManualClock()
+        s = make_sched(stub, window_ms=0, clock=clock, registry=reg)
+        s.pause()
+        doomed = s.submit("i", "Count(Row(f=1))", deadline_ms=10)
+        healthy = s.submit("i", "Count(Row(f=2))")
+        assert s.wait_queued(2) == 2
+        clock.advance(0.05)  # past doomed's deadline
+        s.resume()
+        assert healthy.result(timeout=5) == ["Count(Row(f=2))"]
+        with pytest.raises(QueryDeadlineError):
+            doomed.result(timeout=5)
+        # the expired query never reached the executor
+        assert stub.calls == [("i", ["Count"], None)]
+        assert reg.value(M.METRIC_SCHED_DEADLINE_MISS,
+                         priority="interactive") == 1
+
+    def test_cancel_while_queued(self, make_sched):
+        stub = StubExecutor()
+        s = make_sched(stub, window_ms=0)
+        s.pause()
+        victim = s.submit("i", "Count(Row(f=1))")
+        other = s.submit("i", "Count(Row(f=2))")
+        assert victim.cancel()
+        s.resume()
+        assert other.result(timeout=5) == ["Count(Row(f=2))"]
+        with pytest.raises(QueryDeadlineError):
+            victim.result(timeout=5)
+        assert stub.calls == [("i", ["Count"], None)]
+
+
+class TestErrorIsolation:
+    def test_failing_batch_falls_back_to_solo_runs(self, make_sched):
+        # the fused (multi-call) attempt fails; per-entry re-runs succeed
+        stub = StubExecutor(fail_when=lambda q: len(q.calls) > 1)
+        s = make_sched(stub, window_ms=0, max_batch=64)
+        s.pause()
+        handles = [s.submit("i", f"Count(Row(f={k}))") for k in range(3)]
+        assert s.wait_queued(3) == 3
+        s.resume()
+        assert [h.result(timeout=5) for h in handles] == [
+            [f"Count(Row(f={k}))"] for k in range(3)]
+        assert len(stub.calls) == 4  # 1 failed fused + 3 solo
+
+    def test_poison_query_fails_alone(self, make_sched):
+        stub = StubExecutor(
+            fail_when=lambda q: any("poison" in c.to_pql() for c in q.calls))
+        s = make_sched(stub, window_ms=0, max_batch=64)
+        s.pause()
+        good = s.submit("i", "Count(Row(f=1))")
+        bad = s.submit("i", "Count(Row(poison=1))")
+        assert s.wait_queued(2) == 2
+        s.resume()
+        assert good.result(timeout=5) == ["Count(Row(f=1))"]
+        with pytest.raises(RuntimeError):
+            bad.result(timeout=5)
+
+
+def _mixed_queries():
+    return (["Count(Intersect(Row(city=%d), Row(device=%d)))" % (k % 5, k % 3)
+             for k in range(8)]
+            + ["Row(city=%d)" % (k % 5) for k in range(4)]
+            + ["Intersect(Row(city=1), Row(device=2))",
+               "Union(Row(city=0), Row(city=3))",
+               "Count(Row(device=1))"])
+
+
+@pytest.fixture(scope="module")
+def parity_api():
+    api = API()
+    api.create_index("p")
+    api.create_field("p", "city")
+    api.create_field("p", "device")
+    cols = list(range(300))
+    api.import_bits("p", "city", rows=[c % 5 for c in cols], cols=cols)
+    api.import_bits("p", "device", rows=[c % 3 for c in cols], cols=cols)
+    return api
+
+
+class TestParityWithSequential:
+    def test_batched_results_bit_identical(self, parity_api):
+        api = parity_api
+        queries = _mixed_queries()
+        want = [result_to_json(api.query("p", q)[0]) for q in queries]
+
+        sched = api.enable_scheduler(window_ms=0, max_batch=64)
+        try:
+            sched.pause()
+            handles = [sched.submit("p", q) for q in queries]
+            assert sched.wait_queued(len(queries)) == len(queries)
+            sched.resume()
+            got = [result_to_json(h.result(timeout=10)[0]) for h in handles]
+        finally:
+            api.disable_scheduler()
+        assert got == want
+
+    def test_concurrent_api_query_parity(self, parity_api):
+        api = parity_api
+        queries = _mixed_queries()
+        want = [result_to_json(api.query("p", q)[0]) for q in queries]
+        api.enable_scheduler(window_ms=1.0, max_batch=64)
+        try:
+            with ThreadPoolExecutor(len(queries)) as pool:
+                got = list(pool.map(
+                    lambda q: result_to_json(api.query("p", q)[0]), queries))
+        finally:
+            api.disable_scheduler()
+        assert got == want
+
+    def test_execute_many_matches_execute(self, parity_api):
+        api = parity_api
+        queries = _mixed_queries()
+        want = [[result_to_json(r) for r in api.executor.execute("p", q)]
+                for q in queries]
+        many = api.executor.execute_many("p", queries)
+        assert [[result_to_json(r) for r in rq] for rq in many] == want
+        with pytest.raises(ValueError):
+            api.executor.execute_many("p", ["Set(1, city=1)"])
+
+    def test_sql_select_under_scheduler(self, parity_api):
+        api = parity_api
+        want = api.sql("SELECT COUNT(*) FROM p WHERE city = 1").data
+        api.enable_scheduler(window_ms=0)
+        try:
+            got = api.sql("SELECT COUNT(*) FROM p WHERE city = 1").data
+            # a held admission ticket exhausting max_queue=1... capacity
+            # checks ride the same ticket the engine takes per SELECT
+            with api.scheduler.admit():
+                pass
+        finally:
+            api.disable_scheduler()
+        assert got == want
+
+
+class TestHTTPSurface:
+    def test_429_on_full_queue_and_408_on_deadline(self, parity_api):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from pilosa_tpu.server.http import serve
+
+        api = parity_api
+        clock = ManualClock()
+        sched = api.enable_scheduler(window_ms=0, max_queue=1, clock=clock)
+        srv, _ = serve(api, port=0, background=True)
+        host, port = srv.server_address[:2]
+        base = f"http://{host}:{port}"
+
+        def post(path, body):
+            req = urllib.request.Request(base + path, data=body.encode(),
+                                         method="POST")
+            req.add_header("Content-Type", "text/plain")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            sched.pause()
+            # first request parks in the (size-1) queue on a server thread
+            fills = {}
+
+            def fill():
+                fills["r"] = post("/index/p/query?timeout_ms=10",
+                                  "Count(Row(city=1))")
+
+            t = threading.Thread(target=fill)
+            t.start()
+            assert sched.wait_queued(1) == 1
+            code, body = post("/index/p/query", "Count(Row(city=2))")
+            assert code == 429 and "full" in body["error"]
+            # expire the parked query's deadline, then release the worker
+            clock.advance(0.05)
+            sched.resume()
+            t.join(timeout=10)
+            assert fills["r"][0] == 408
+            # healthy path still serves through the scheduler
+            code, body = post("/index/p/query", "Count(Row(city=1))")
+            assert code == 200
+        finally:
+            api.disable_scheduler()
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestConfigSurface:
+    def test_scheduler_config_fields(self):
+        from pilosa_tpu.config import Config
+
+        cfg = Config.from_sources(env={
+            "PILOSA_TPU_SCHEDULER_ENABLED": "true",
+            "PILOSA_TPU_SCHEDULER_WINDOW_MS": "2.5",
+            "PILOSA_TPU_SCHEDULER_MAX_BATCH": "16",
+            "PILOSA_TPU_SCHEDULER_MAX_QUEUE": "99",
+        })
+        assert cfg.scheduler_enabled is True
+        assert cfg.scheduler_window_ms == 2.5
+        assert cfg.scheduler_max_batch == 16
+        assert cfg.scheduler_max_queue == 99
+
+    def test_from_config_builder(self, make_sched):
+        from pilosa_tpu.config import Config
+
+        cfg = Config()
+        cfg.scheduler_window_ms = 3.0
+        cfg.scheduler_max_batch = 7
+        s = QueryScheduler.from_config(StubExecutor(), cfg,
+                                       registry=MetricsRegistry())
+        try:
+            assert s.window_s == 0.003
+            assert s.max_batch == 7
+        finally:
+            s.close()
+
+    def test_enable_disable_roundtrip(self):
+        api = API()
+        api.create_index("r")
+        api.create_field("r", "f")
+        api.enable_scheduler(window_ms=0)
+        assert type(api.read_executor()).__name__ == "SchedulingExecutor"
+        api.disable_scheduler()
+        assert api.read_executor() is api.executor
+        assert api.scheduler is None
