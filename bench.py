@@ -383,6 +383,93 @@ def bench_config6(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 7 — result cache: intersect-count across cold/warm/write phases
+# ---------------------------------------------------------------------------
+
+def bench_config7(device: str) -> None:
+    """Repeated intersect-count through the version-keyed result cache
+    (cache/). Three phases, every read oracle-checked against numpy:
+    cold (flush before each read — full dispatch), warm (identical
+    repeat — hit, skips the ~floor_ms dispatch entirely), and
+    write-invalidated (a Set between reads structurally invalidates the
+    entry, so each read re-dispatches and must return the post-write
+    count — a stale hit fails the assert). Cache-off baseline included."""
+    from pilosa_tpu.api import API
+
+    rng = np.random.default_rng(7)
+    n = _n(1_000_000)
+    city = rng.integers(0, 50, n)
+    dev = rng.integers(0, 10, n)
+    api = API()
+    api.create_index("c7")
+    api.create_field("c7", "city")
+    api.create_field("c7", "device")
+    cols = np.arange(n)
+    api.import_bits("c7", "city", rows=city, cols=cols)
+    api.import_bits("c7", "device", rows=dev, cols=cols)
+
+    q = "Count(Intersect(Row(city=3), Row(device=7)))"
+    want = int(np.sum((city == 3) & (dev == 7)))
+    api.query("c7", q)  # warm: compile + upload planes
+    iters = max(QUERY_ITERS, 5)
+
+    def timed():
+        t0 = time.perf_counter()
+        r = api.query("c7", q)[0]
+        return r, time.perf_counter() - t0
+
+    # cache OFF: the unmodified read path
+    off = []
+    for _ in range(iters):
+        r, s = timed()
+        assert r == want
+        off.append(s)
+
+    cache = api.enable_cache()
+    try:
+        # cold: flush before every read, each pays the dispatch floor
+        cold = []
+        for _ in range(iters):
+            cache.flush()
+            r, s = timed()
+            assert r == want
+            cold.append(s)
+        # warm: identical repeats are hits
+        timed()  # fill
+        warm = []
+        for _ in range(iters * 4):
+            r, s = timed()
+            assert r == want
+            warm.append(s)
+        # write-invalidated: interleave writes with reads; fragment
+        # versions in the key force a re-dispatch with the fresh count
+        inval = []
+        exp = want
+        for i in range(iters):
+            c = n + i
+            api.query("c7", f"Set({c}, city=3)Set({c}, device=7)")
+            exp += 1
+            r, s = timed()
+            assert r == exp, (r, exp)
+            inval.append(s)
+    finally:
+        api.disable_cache()
+
+    def pct(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    warm_p50 = pct(warm, 0.5)
+    _emit(f"c7_cache_warm_intersect_count_p50{SCALED} ({device})",
+          warm_p50, "ms", pct(cold, 0.5) / max(warm_p50, 1e-6),
+          cold_p50_ms=pct(cold, 0.5), cold_p99_ms=pct(cold, 0.99),
+          warm_p99_ms=pct(warm, 0.99),
+          warm_qps=len(warm) / max(sum(warm), 1e-9),
+          inval_p50_ms=pct(inval, 0.5), inval_p99_ms=pct(inval, 0.99),
+          off_p50_ms=pct(off, 0.5), floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -529,6 +616,7 @@ _CONFIGS = {
     "4": bench_config4,
     "5": bench_config5,
     "6": bench_config6,
+    "7": bench_config7,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 
@@ -659,6 +747,10 @@ def orchestrate() -> int:
 
 if __name__ == "__main__":
     child = os.environ.get("PILOSA_BENCH_CHILD")
+    if not child and "--configs" in sys.argv[1:]:
+        # `bench.py --configs 7` runs one config in-process (same as the
+        # child env var, minus the orchestrator's probe/fallback logic)
+        child = sys.argv[sys.argv.index("--configs") + 1]
     if child:
         sys.exit(main(child))
     sys.exit(orchestrate())

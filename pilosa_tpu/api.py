@@ -47,6 +47,10 @@ class API:
         # None = sequential path. Enabled via enable_scheduler / config
         # scheduler_enabled — reads then coalesce into fused dispatches.
         self.scheduler = None
+        # optional version-keyed result cache (cache/); None = off and
+        # the read path is untouched. Enabled via enable_cache / config
+        # cache_enabled.
+        self.cache = None
         # optional structured query log (reference: server.go:792);
         # set via api.set_query_logger / config query_log_path
         self.query_logger = None
@@ -89,6 +93,27 @@ class API:
         if self.scheduler is not None:
             return self.scheduler.as_executor()
         return self.executor
+
+    # -- result cache (cache/: version-keyed + single-flight) --------------
+
+    def enable_cache(self, config=None, **overrides):
+        """Cache read results keyed on (index, PQL, shard set, fragment
+        versions) — repeated reads of unchanged data skip the dispatch
+        floor entirely, and identical in-flight reads share one
+        dispatch. ``config`` is a pilosa_tpu.config.Config; kwargs
+        override individual knobs (max_bytes, max_entries, ttl_ms,
+        registry, clock). Attaching to the executor covers both the
+        direct and the scheduled read path (the scheduler consults
+        executor.cache on admission)."""
+        from pilosa_tpu.cache import ResultCache
+
+        self.cache = ResultCache.from_config(config, **overrides)
+        self.executor.cache = self.cache
+        return self.cache
+
+    def disable_cache(self) -> None:
+        self.cache = None
+        self.executor.cache = None
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 

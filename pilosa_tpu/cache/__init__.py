@@ -1,0 +1,21 @@
+"""Version-keyed query result cache with single-flight dedup.
+
+Every read-path p50 sits on the ~67ms per-dispatch floor (BENCH_r05);
+repeated reads of unchanged fragments can skip the device entirely.
+Entries are keyed on (index, canonical PQL, frozen shard set, fragment
+version fingerprint) so writes self-invalidate them — see keys.py for
+the key scheme and result_cache.py for the LRU + single-flight core.
+"""
+
+from pilosa_tpu.cache.keys import (is_cacheable, query_cache_key,
+                                   shard_key, version_fingerprint)
+from pilosa_tpu.cache.result_cache import ResultCache, estimate_cost
+
+__all__ = [
+    "ResultCache",
+    "estimate_cost",
+    "is_cacheable",
+    "query_cache_key",
+    "shard_key",
+    "version_fingerprint",
+]

@@ -1,0 +1,304 @@
+"""Bounded, cost-accounted LRU result cache with single-flight dedup.
+
+Keys are opaque hashable tuples built by keys.py: because the fragment
+version fingerprint is part of the key, a write makes every covering
+entry unreachable — eviction (LRU/bytes/TTL) is purely a memory-bound
+concern, never a correctness one.
+
+Single flight: the first thread to miss on a key becomes the *leader*
+and computes; concurrent threads missing on the same key become
+*followers* and block on the leader's future instead of dispatching a
+duplicate kernel. Under the 64-way concurrent bench this collapses
+identical cold queries to one dispatch.
+
+Values are deep-copied on insert and on every hit so callers can mutate
+their result (sql/engine.py stamps ``exec_ms`` on returned SQLResults)
+without corrupting the cached copy.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from pilosa_tpu.obs import metrics as M
+
+try:  # cost model only; the cache itself is numpy-free
+    import numpy as _np
+except Exception:  # pragma: no cover
+    _np = None
+
+
+def estimate_cost(value: Any) -> int:
+    """Approximate resident bytes of a result value (iterative, cycle
+    safe). Precision doesn't matter — the estimate only drives the
+    max-bytes budget, and consistent undercounting across entries keeps
+    eviction order sane."""
+    total = 0
+    stack = [value]
+    seen = set()
+    while stack:
+        v = stack.pop()
+        if v is None or isinstance(v, (bool, int, float)):
+            total += 16
+        elif isinstance(v, str):
+            total += 49 + len(v)
+        elif isinstance(v, (bytes, bytearray)):
+            total += 33 + len(v)
+        elif _np is not None and isinstance(v, _np.ndarray):
+            total += int(v.nbytes) + 96
+        elif _np is not None and isinstance(v, _np.generic):
+            total += 32
+        else:
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            if isinstance(v, dict):
+                total += 64 + 16 * len(v)
+                stack.extend(v.keys())
+                stack.extend(v.values())
+            elif isinstance(v, (list, tuple, set, frozenset)):
+                total += 56 + 8 * len(v)
+                stack.extend(v)
+            elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+                total += 64
+                stack.extend(getattr(v, f.name)
+                             for f in dataclasses.fields(v))
+            elif hasattr(v, "__dict__"):
+                total += 64
+                stack.extend(vars(v).values())
+            else:
+                total += 64
+    return total
+
+
+@dataclasses.dataclass
+class _Entry:
+    value: Any
+    cost: int
+    expires_at: float  # monotonic deadline; inf = no TTL
+
+
+class ResultCache:
+    """Thread-safe LRU keyed by opaque tuples, with byte + entry bounds,
+    optional TTL, and single-flight in-flight dedup.
+
+    The primitive API (``fetch``/``complete``/``fail``) exists for call
+    sites that batch several keys into one dispatch (executor
+    ``execute_many``); ``run`` wraps the common one-key case."""
+
+    def __init__(self, *, max_bytes: int = 64 << 20,
+                 max_entries: int = 4096, ttl_ms: float = 0.0,
+                 registry: Optional[M.MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_bytes = int(max_bytes)
+        self.max_entries = int(max_entries)
+        self.ttl_ms = float(ttl_ms)
+        self.registry = registry if registry is not None else M.REGISTRY
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._inflight: Dict[Tuple, Future] = {}
+        # local counters for /internal/cache/stats — independent of the
+        # (possibly shared/global) metrics registry
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    @classmethod
+    def from_config(cls, config=None, **overrides) -> "ResultCache":
+        kw = {}
+        if config is not None:
+            kw = {"max_bytes": config.cache_max_bytes,
+                  "max_entries": config.cache_max_entries,
+                  "ttl_ms": config.cache_ttl_ms}
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- primitives --------------------------------------------------------
+
+    def lookup(self, key: Tuple, count_miss: bool = True
+               ) -> Tuple[bool, Any]:
+        """(hit, value). Counts hit/miss and observes hit latency.
+        ``count_miss=False`` makes a miss silent — for peek-style call
+        sites (scheduler admission) whose misses fall through to a
+        second, authoritative lookup at dispatch."""
+        t0 = time.perf_counter()
+        with self._lock:
+            value, hit = self._get_locked(key)
+        if hit:
+            self._hits += 1
+            self.registry.count(M.METRIC_CACHE_HITS)
+            self.registry.observe_bucketed(
+                M.METRIC_CACHE_HIT_LATENCY, time.perf_counter() - t0,
+                M.CACHE_LATENCY_BUCKETS)
+            return True, value
+        if count_miss:
+            self._misses += 1
+            self.registry.count(M.METRIC_CACHE_MISSES)
+        return False, None
+
+    def fetch(self, key: Tuple) -> Tuple[str, Any]:
+        """Single lookup + single-flight claim under one lock hold.
+
+        Returns one of:
+          ("hit", value)       — cached; counts a hit
+          ("leader", None)     — caller must compute, then ``complete``
+                                 or ``fail`` the key; counts a miss
+          ("follower", future) — another thread is computing; block on
+                                 the future (deep-copy its result)
+        """
+        t0 = time.perf_counter()
+        with self._lock:
+            value, hit = self._get_locked(key)
+            if hit:
+                outcome: Tuple[str, Any] = ("hit", value)
+            else:
+                fut = self._inflight.get(key)
+                if fut is not None:
+                    outcome = ("follower", fut)
+                else:
+                    self._inflight[key] = Future()
+                    outcome = ("leader", None)
+        if outcome[0] == "hit":
+            self._hits += 1
+            self.registry.count(M.METRIC_CACHE_HITS)
+            self.registry.observe_bucketed(
+                M.METRIC_CACHE_HIT_LATENCY, time.perf_counter() - t0,
+                M.CACHE_LATENCY_BUCKETS)
+        elif outcome[0] == "leader":
+            self._misses += 1
+            self.registry.count(M.METRIC_CACHE_MISSES)
+        else:
+            self.registry.count(M.METRIC_CACHE_SINGLEFLIGHT)
+        return outcome
+
+    def complete(self, key: Tuple, value: Any) -> None:
+        """Leader publishes its result: insert + wake followers."""
+        self.insert(key, value)
+        with self._lock:
+            fut = self._inflight.pop(key, None)
+        if fut is not None:
+            fut.set_result(value)
+
+    def fail(self, key: Tuple, exc: BaseException) -> None:
+        """Leader's compute raised: propagate to followers, cache
+        nothing (the next request retries)."""
+        with self._lock:
+            fut = self._inflight.pop(key, None)
+        if fut is not None:
+            fut.set_exception(exc)
+
+    def insert(self, key: Tuple, value: Any) -> None:
+        cost = estimate_cost(value)
+        if cost > self.max_bytes:
+            return  # would evict the whole cache for one entry
+        expires = (self.clock() + self.ttl_ms / 1000.0
+                   if self.ttl_ms > 0 else float("inf"))
+        stored = copy.deepcopy(value)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.cost
+            self._entries[key] = _Entry(stored, cost, expires)
+            self._bytes += cost
+            while len(self._entries) > self.max_entries:
+                self._evict_locked("entries")
+            while self._bytes > self.max_bytes and self._entries:
+                self._evict_locked("bytes")
+            self._update_gauges_locked()
+
+    def run(self, key: Tuple, compute: Callable[[], Any]) -> Any:
+        """Hit → cached copy. Miss as leader → compute (timed into the
+        dispatch-latency histogram), publish, return the *original*
+        object (the caller may keep mutating it; the cache holds a deep
+        copy). Miss as follower → wait for the leader and return a copy.
+        """
+        state, payload = self.fetch(key)
+        if state == "hit":
+            return payload
+        if state == "follower":
+            return copy.deepcopy(payload.result())
+        t0 = time.perf_counter()
+        try:
+            value = compute()
+        except BaseException as exc:
+            self.fail(key, exc)
+            raise
+        self.observe_dispatch(time.perf_counter() - t0)
+        self.complete(key, value)
+        return value
+
+    # -- accounting helpers ------------------------------------------------
+
+    def bypass(self) -> None:
+        """An uncacheable request passed through (key was None)."""
+        self.registry.count(M.METRIC_CACHE_BYPASS)
+
+    def observe_dispatch(self, seconds: float) -> None:
+        """Compute time behind a miss — contrast with the hit
+        histogram to read the amortization win off /metrics."""
+        self.registry.observe_bucketed(
+            M.METRIC_CACHE_DISPATCH_LATENCY, seconds,
+            M.CACHE_LATENCY_BUCKETS)
+
+    def flush(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self._update_gauges_locked()
+        if n:
+            self._evictions += n
+            self.registry.count(M.METRIC_CACHE_EVICTIONS, n, reason="flush")
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "ttl_ms": self.ttl_ms,
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "inflight": len(self._inflight),
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- internals (lock held) ---------------------------------------------
+
+    def _get_locked(self, key: Tuple) -> Tuple[Any, bool]:
+        e = self._entries.get(key)
+        if e is None:
+            return None, False
+        if e.expires_at <= self.clock():
+            del self._entries[key]
+            self._bytes -= e.cost
+            self._evictions += 1
+            self.registry.count(M.METRIC_CACHE_EVICTIONS, reason="ttl")
+            self._update_gauges_locked()
+            return None, False
+        self._entries.move_to_end(key)
+        return copy.deepcopy(e.value), True
+
+    def _evict_locked(self, reason: str) -> None:
+        _, e = self._entries.popitem(last=False)
+        self._bytes -= e.cost
+        self._evictions += 1
+        self.registry.count(M.METRIC_CACHE_EVICTIONS, reason=reason)
+
+    def _update_gauges_locked(self) -> None:
+        self.registry.gauge(M.METRIC_CACHE_ENTRIES, len(self._entries))
+        self.registry.gauge(M.METRIC_CACHE_BYTES, self._bytes)

@@ -55,6 +55,15 @@ class ClusterExecutor:
         # set by ClusterNode.enable_scheduler; coordinator fan-out then
         # coalesces its local shard groups with concurrent coordinators'
         self.scheduler = None
+        # optional result cache (cache/), set by ClusterNode.enable_cache.
+        # The local fan-out leg caches inside self.local with exact
+        # fragment-version keys; the REMOTE leg has no local versions to
+        # key on, so its per-shard-leg partials are cached only when a
+        # TTL bounds staleness (ttl_ms > 0), keyed additionally on this
+        # coordinator's per-index write epoch (self-coordinated writes
+        # invalidate immediately; other writers are TTL-bounded).
+        self.cache = None
+        self._write_epoch: Dict[str, int] = {}
         self.translator = ClusterTranslator(node_id, holder, client,
                                             snapshot_fn, live_fn=live_fn)
 
@@ -145,11 +154,27 @@ class ClusterExecutor:
         """Run `call` over the shards wherever they live; returns per-node
         partial results (untranslated, untruncated)."""
         pql = call.to_pql()
+
+        def run_remote(node, s):
+            return R.result_from_wire(
+                self.client.query_node(node, idx.name, pql, s)[0])
+
+        cache = self.cache
+        if cache is not None and cache.ttl_ms > 0:
+            from pilosa_tpu.cache.keys import shard_key
+
+            def run_remote_cached(node, s, _raw=run_remote):
+                # per-shard-leg partials: a later query overlapping only
+                # some of these shards still hits on the shared legs
+                key = ("rleg", idx.name, pql, shard_key(s),
+                       self._write_epoch.get(idx.name, 0))
+                return cache.run(key, lambda: _raw(node, s))
+
+            run_remote = run_remote_cached
         return self._fan_shards(
             idx.name, shards,
             lambda s: self._run_local_read(idx.name, call, s),
-            lambda node, s: R.result_from_wire(
-                self.client.query_node(node, idx.name, pql, s)[0]))
+            run_remote)
 
     def _run_local_read(self, index: str, call: Call,
                         shards: Sequence[int]) -> Any:
@@ -375,6 +400,9 @@ class ClusterExecutor:
                     r = fut.result()
                     if rank == 0:
                         result = _merge_write(result, r)
+        # invalidate remote-leg cache entries for this index (local-leg
+        # entries self-invalidate via fragment versions)
+        self._write_epoch[idx.name] = self._write_epoch.get(idx.name, 0) + 1
         self._after_write(idx)
         return result
 

@@ -235,6 +235,28 @@ class ClusterNode:
         if sched is not None:
             sched.close()
 
+    # -- result cache (cache/): same surface as the plain API --------------
+
+    @property
+    def cache(self):
+        return self.executor.cache
+
+    def enable_cache(self, config=None, **overrides):
+        """Attach a result cache to the node: the LOCAL fan-out leg gets
+        exact fragment-version keying (inside executor.local); remote
+        per-shard-leg partials are cached only when ttl_ms > 0 — see
+        ClusterExecutor.cache."""
+        from pilosa_tpu.cache import ResultCache
+
+        cache = ResultCache.from_config(config, **overrides)
+        self.executor.cache = cache
+        self.executor.local.cache = cache
+        return cache
+
+    def disable_cache(self) -> None:
+        self.executor.cache = None
+        self.executor.local.cache = None
+
     def read_executor(self):
         """SQL read plans run against the cluster executor either way —
         its local legs consult executor.scheduler themselves."""

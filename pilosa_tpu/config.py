@@ -30,6 +30,44 @@ def env_bool(name: str, default: bool = False) -> bool:
     return default if raw is None else _truthy(raw)
 
 
+def _toml_value(val: str):
+    if val.startswith("[") and val.endswith("]"):
+        inner = val[1:-1].strip()
+        return [_toml_value(p.strip()) for p in inner.split(",")
+                if p.strip()] if inner else []
+    if len(val) >= 2 and val[0] == val[-1] and val[0] in ("'", '"'):
+        return val[1:-1]
+    if val in ("true", "false"):
+        return val == "true"
+    for conv in (int, float):
+        try:
+            return conv(val)
+        except ValueError:
+            pass
+    return val
+
+
+def _parse_toml_subset(text: str) -> Dict[str, Any]:
+    """Minimal TOML reader for Pythons without stdlib tomllib (< 3.11):
+    [section] headers, key = string / int / float / bool /
+    array-of-strings, full-line # comments — the dialect ``to_toml``
+    emits and the docs use. Real tomllib is preferred when present."""
+    doc: Dict[str, Any] = {}
+    cur = doc
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = doc.setdefault(line[1:-1].strip(), {})
+            continue
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"unparsable config line: {raw!r}")
+        cur[key.strip()] = _toml_value(val.strip())
+    return doc
+
+
 @dataclasses.dataclass
 class Config:
     # listener
@@ -65,6 +103,12 @@ class Config:
     scheduler_max_batch: int = 64  # queries fused per dispatch
     scheduler_max_queue: int = 1024  # admission bound (429 beyond)
     scheduler_default_deadline_ms: float = 0.0  # <=0: no deadline
+    # result cache ([cache] section / PILOSA_TPU_CACHE_*): version-keyed
+    # read result caching + single-flight dedup (cache/)
+    cache_enabled: bool = False
+    cache_max_bytes: int = 64 << 20
+    cache_max_entries: int = 4096
+    cache_ttl_ms: float = 0.0  # <=0: no TTL (and remote-leg caching off)
 
     # -- sources -----------------------------------------------------------
 
@@ -97,10 +141,16 @@ class Config:
 
     @staticmethod
     def _load_toml(path: str) -> Dict[str, Any]:
-        import tomllib
-
-        with open(path, "rb") as f:
-            doc = tomllib.load(f)
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # Python < 3.11: stdlib has no tomllib
+            tomllib = None
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                doc = tomllib.load(f)
+        else:
+            with open(path, encoding="utf-8") as f:
+                doc = _parse_toml_subset(f.read())
         flat: Dict[str, Any] = {}
         for k, v in doc.items():
             if isinstance(v, dict):  # [section] key -> section_key

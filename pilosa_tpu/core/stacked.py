@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import platform
 from pilosa_tpu.ops import bitmap as bitops
 from pilosa_tpu.ops import bsi as bsiops
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
@@ -546,6 +547,7 @@ def release_field_cache(field) -> None:
 # out-of-bounds word indices (one XLA executable per pow2 bucket instead
 # of one per distinct delta count), and dropped pads can't race a real
 # entry the way a duplicated in-bounds pad index would.
+@platform.guarded_call
 @jax.jit
 def _apply_bit_deltas(planes, slots, words, orm, anm):
     cur = planes[slots, words]  # pads clamp-read; their writes are dropped
@@ -555,6 +557,7 @@ def _apply_bit_deltas(planes, slots, words, orm, anm):
 import functools
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("new_rows",))
 def _grow_rows_device(planes, new_rows: int):
     """Zero-pad a block/stack with ``new_rows`` extra slots on device —

@@ -43,6 +43,8 @@ def cmd_server(args) -> int:
     api.holder.checkpoint_bytes = cfg.checkpoint_bytes
     if cfg.scheduler_enabled:
         api.enable_scheduler(cfg)
+    if cfg.cache_enabled:
+        api.enable_cache(cfg)
     if cfg.query_log_path:
         api.set_query_logger(cfg.query_log_path)
     auth = None

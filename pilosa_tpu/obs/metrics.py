@@ -52,6 +52,20 @@ METRIC_SCHED_BATCHES = "sched_batches_total"
 METRIC_SCHED_QUERIES = "sched_queries_total"
 # batch-size buckets: powers of two up to the default max_batch
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+# result cache (cache/): version-keyed read caching + single-flight
+METRIC_CACHE_HITS = "cache_hits_total"
+METRIC_CACHE_MISSES = "cache_misses_total"
+METRIC_CACHE_BYPASS = "cache_bypass_total"
+METRIC_CACHE_EVICTIONS = "cache_evictions_total"
+METRIC_CACHE_SINGLEFLIGHT = "cache_singleflight_waits_total"
+METRIC_CACHE_ENTRIES = "cache_entries"
+METRIC_CACHE_BYTES = "cache_resident_bytes"
+METRIC_CACHE_HIT_LATENCY = "cache_hit_seconds"  # histogram
+METRIC_CACHE_DISPATCH_LATENCY = "cache_dispatch_seconds"  # histogram
+# hit path is sub-ms; dispatch path sits at the ~67ms device floor —
+# one bucket layout spans both so the two histograms compare directly
+CACHE_LATENCY_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
+                         0.1, 0.25, 1.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pilosa_tpu import native
+from pilosa_tpu import native, platform
 from pilosa_tpu.shardwidth import BITS_PER_WORD, SHARD_WIDTH, WORDS_PER_SHARD
 
 # ---------------------------------------------------------------------------
@@ -104,6 +104,7 @@ def plane_not(a, existence):
     return plane_andnot(existence, a)
 
 
+@platform.guarded_call
 @jax.jit
 def plane_shift(a):
     """Shift all columns by +1 (reference: roaring/roaring.go:1629 Shift).
@@ -137,7 +138,10 @@ def zeros_varying_like(ref, shape, dtype):
     """Zeros carrying the same varying-manual-axes type as ``ref`` — the
     correct scan-carry init for code that may trace inside shard_map."""
     z = jnp.zeros(shape, dtype=dtype)
-    vma = getattr(jax.typeof(ref), "vma", frozenset())
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # pre-typeof jax: avals carry no varying-axes type
+        return z
+    vma = getattr(typeof(ref), "vma", frozenset())
     return _mark_varying(z, tuple(vma)) if vma else z
 
 
@@ -146,6 +150,7 @@ def host_popcount(x: np.ndarray) -> int:
     return native.popcount(np.ascontiguousarray(x))
 
 
+@platform.guarded_call
 @jax.jit
 def plane_count(a):
     """Total set bits (reference: roaring Count / fragment popcount paths).
@@ -153,6 +158,7 @@ def plane_count(a):
     return jnp.sum(_popcount_i32(a))
 
 
+@platform.guarded_call
 @jax.jit
 def plane_intersection_count(a, b):
     """popcount(a AND b) without materializing the AND on host (reference:
@@ -161,6 +167,7 @@ def plane_intersection_count(a, b):
     return jnp.sum(_popcount_i32(jnp.bitwise_and(a, b)))
 
 
+@platform.guarded_call
 @jax.jit
 def row_counts(planes, filt=None):
     """Per-row popcounts of a fragment tensor ``uint32[R, W]``, optionally

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import platform
 from pilosa_tpu.ops.bitmap import _popcount_i32 as _pc
 from pilosa_tpu.ops.bitmap import bits_to_plane
 
@@ -85,6 +86,7 @@ def _mag_compare(mag_planes, candidates, cbits, coverflow):
     return lt, eq, gt
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("op",))
 def _compare_kernel(planes, op, cbits, cover, cneg, c2bits, c2over, c2neg):
     exists = planes[EXISTS]
@@ -193,6 +195,7 @@ def encode_values(cols, values, depth: int, words: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+@platform.guarded_call
 @jax.jit
 def bsi_plane_popcounts(planes, filt):
     """Per-magnitude-plane popcounts split by sign, plus the filtered count.
@@ -250,6 +253,7 @@ def _walk_min_mag(S, mags):
     return jnp.stack(bits), S
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("want_max",))
 def _minmax_kernel(planes, filt, want_max):
     exists = planes[EXISTS]
@@ -288,6 +292,7 @@ def _assemble(bits, negative) -> int:
     return -v if negative else v
 
 
+@platform.guarded_call
 @jax.jit
 def _kth_kernel(planes, filt, nth_times_100):
     """Select the value at percentile ``nth`` (0..100, scaled x100 as an

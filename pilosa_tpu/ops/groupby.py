@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pilosa_tpu import platform
 from pilosa_tpu.ops.bitmap import zeros_varying_like
 
 # Words per column-block of the matmul: 2048 words = 65536 bit-columns
@@ -127,6 +128,7 @@ def _pallas_kernel(a_ref, b_ref, out_ref):
         out_ref[:, :] += blk
 
 
+@platform.guarded_call
 @jax.jit
 def _pair_counts_pallas(a, b):
     """Fused bit-expansion + int8 MXU matmul: the expansion lives in
@@ -160,6 +162,7 @@ def _pair_counts_pallas(a, b):
     return out[:r1, :r2]
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("block_words",))
 def _pair_counts_xla(a, b, block_words: int = BLOCK_WORDS):
     """The XLA scan formulation (shard_map-compatible; all backends)."""
@@ -197,6 +200,7 @@ def _pair_counts_xla(a, b, block_words: int = BLOCK_WORDS):
     return acc
 
 
+@platform.guarded_call
 @jax.jit
 def masked_pair_counts(a, b, filt):
     """pair_counts with both sides pre-intersected by a filter plane
@@ -204,6 +208,7 @@ def masked_pair_counts(a, b, filt):
     return pair_counts(a & filt[None, :], b & filt[None, :])
 
 
+@platform.guarded_call
 @jax.jit
 def pair_sums(a, b, mags, pos, neg):
     """Per-magnitude-plane pair counts for two-field GroupBy with a Sum

@@ -15,9 +15,11 @@ import functools
 import jax
 from jax import lax
 
+from pilosa_tpu import platform
 from pilosa_tpu.ops.bitmap import row_counts
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("k",))
 def _topk_kernel(planes, filt, k):
     return lax.top_k(row_counts(planes, filt), k)

@@ -34,6 +34,7 @@ try:  # jax >= 0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from pilosa_tpu import platform
 from pilosa_tpu.ops.bitmap import _popcount_i32, zeros_varying_like
 from pilosa_tpu.ops.groupby import pair_counts
 
@@ -122,7 +123,9 @@ def engine_sharding(ndim: int,
 def engine_put(host: np.ndarray) -> jax.Array:
     """device_put a stacked tensor with the engine placement."""
     sh = engine_sharding(host.ndim, host.shape[-1])
-    return jax.device_put(host, sh) if sh is not None else jax.device_put(host)
+    with platform.dispatch_guard():  # leaf: multi-device transfer program
+        return (jax.device_put(host, sh) if sh is not None
+                else jax.device_put(host))
 
 
 def analytics_mesh(devices: Optional[Sequence] = None,
@@ -154,8 +157,9 @@ class ShardPlacement:
 
     def place(self, arr) -> jax.Array:
         arr = np.asarray(arr)
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, self.spec(arr.ndim)))
+        with platform.dispatch_guard():  # leaf: multi-device transfer
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, self.spec(arr.ndim)))
 
     # -- collective kernels ------------------------------------------------
 
@@ -189,6 +193,7 @@ def _specs(mesh, *in_ndims, out):
                 out_specs=out)
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _count(mesh, planes):
     @functools.partial(_shard_map, **_specs(mesh, 2, out=P()))
@@ -198,6 +203,7 @@ def _count(mesh, planes):
     return f(planes)
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _intersect_count(mesh, a, b):
     @functools.partial(_shard_map, **_specs(mesh, 2, 2, out=P()))
@@ -207,6 +213,7 @@ def _intersect_count(mesh, a, b):
     return f(a, b)
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _row_counts(mesh, planes):
     @functools.partial(_shard_map, **_specs(mesh, 3, out=P()))
@@ -216,6 +223,7 @@ def _row_counts(mesh, planes):
     return f(planes)
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _groupby_counts(mesh, a, b):
     @functools.partial(_shard_map, **_specs(mesh, 3, 3, out=P()))
@@ -230,6 +238,7 @@ def _groupby_counts(mesh, a, b):
     return f(a, b)
 
 
+@platform.guarded_call
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _bsi_sum_counts(mesh, planes, filt):
     from pilosa_tpu.ops.bsi import EXISTS, OFFSET, SIGN

@@ -19,13 +19,17 @@ reuses the same monoid reduce shapes.
 
 from __future__ import annotations
 
+import copy
 import datetime as dt
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import platform
+from pilosa_tpu.cache.keys import query_cache_key
 from pilosa_tpu.core import timeq
 from pilosa_tpu.core.field import Field
 from pilosa_tpu.core.holder import Holder
@@ -125,19 +129,55 @@ class Executor:
     def __init__(self, holder: Holder, remote: bool = False):
         self.holder = holder
         self.remote = remote
+        # result cache (cache/), attached by api.enable_cache(). None
+        # keeps the read path byte-identical to the uncached build.
+        self.cache = None
         self._zeros: Dict[int, jnp.ndarray] = {}
 
     # -- public entry (reference: executor.go:183 Execute) --------------------
 
     def execute(self, index: str, query, shards: Optional[Sequence[int]] = None
                 ) -> List[Any]:
-        from pilosa_tpu.core.stacked import StackStale
-
         idx = self.holder.index(index)
         if isinstance(query, str):
             query = parse(query)
         if isinstance(query, Call):
             query = Query([query])
+        if has_write_calls(query):
+            with self.holder.write_lock:
+                return self._execute_query(idx, query, shards)
+        cache = self.cache
+        if cache is not None:
+            key = self.cache_key(idx, query, shards)
+            if key is None:
+                cache.bypass()
+            else:
+                return cache.run(
+                    key, lambda: self._execute_read(idx, query, shards))
+        return self._execute_read(idx, query, shards)
+
+    def cache_key(self, index, query,
+                  shards: Optional[Sequence[int]] = None) -> Optional[Tuple]:
+        """Result-cache key for a read query against this executor (None
+        when uncacheable: writes, ExternalLookup, per-call shard
+        overrides). Accepts an Index or a name, str/Call queries like
+        ``execute``. The namespace pins the result dialect: a
+        remote=True executor returns untranslated, untruncated partials
+        for the same PQL text (see class docstring)."""
+        idx = index if isinstance(index, Index) else self.holder.index(index)
+        if isinstance(query, str):
+            query = parse(query)
+        if isinstance(query, Call):
+            query = Query([query])
+        if has_write_calls(query):
+            return None
+        return query_cache_key(
+            idx, query, self._shards(idx, shards),
+            namespace="remote" if self.remote else "local")
+
+    def _execute_read(self, idx: Index, query: Query, shards) -> List[Any]:
+        from pilosa_tpu.core.stacked import StackStale
+
         # Paged stacks build blocks lazily; a concurrent write landing
         # mid-stream makes the remaining lazy builds StackStale. PQL
         # reads are pure, so retry on a fresh (post-write) stack; the
@@ -146,8 +186,6 @@ class Executor:
         # consume blocks eagerly within each call, and re-running a Set
         # would corrupt the changed-flags — they execute once (their
         # surrounding Qcx already excludes concurrent writers).
-        if has_write_calls(query):
-            return self._execute_query(idx, query, shards)
         for _ in range(3):
             try:
                 return self._execute_query(idx, query, shards)
@@ -170,8 +208,6 @@ class Executor:
         every call of every query dispatches asynchronously, then all
         copies overlap, so N concurrent queries pay one round-trip floor
         exactly like N top-level calls of a single ``execute``."""
-        from pilosa_tpu.core.stacked import StackStale
-
         idx = self.holder.index(index)
         qs: List[Query] = []
         for q in queries:
@@ -182,6 +218,15 @@ class Executor:
             if has_write_calls(q):
                 raise ValueError("execute_many is read-only")
             qs.append(q)
+        if self.cache is None:
+            return self._execute_many_retry(idx, qs, shards)
+        return self._execute_many_cached(idx, qs, shards)
+
+    def _execute_many_retry(self, idx: Index, qs: Sequence[Query],
+                            shards) -> List[List[Any]]:
+        from pilosa_tpu.core.stacked import StackStale
+
+        # same StackStale retry contract as _execute_read
         for _ in range(3):
             try:
                 return self._execute_many(idx, qs, shards)
@@ -189,6 +234,50 @@ class Executor:
                 continue
         with self.holder.write_lock:
             return self._execute_many(idx, qs, shards)
+
+    def _execute_many_cached(self, idx: Index, qs: Sequence[Query],
+                             shards) -> List[List[Any]]:
+        """Per-query cache fill around ONE fused dispatch: hits and
+        single-flight followers drop out of the batch; all remaining
+        queries (miss leaders + uncacheable bypasses) still go through
+        a single ``_execute_many`` so the fusion amortization is kept."""
+        cache = self.cache
+        shard_list = self._shards(idx, shards)
+        ns = "remote" if self.remote else "local"
+        results: List[Optional[List[Any]]] = [None] * len(qs)
+        to_run: List[Tuple[int, Optional[Tuple]]] = []  # (slot, key|None)
+        followers = []  # (slot, future)
+        for i, q in enumerate(qs):
+            key = query_cache_key(idx, q, shard_list, namespace=ns)
+            if key is None:
+                cache.bypass()
+                to_run.append((i, None))
+                continue
+            state, payload = cache.fetch(key)
+            if state == "hit":
+                results[i] = payload
+            elif state == "leader":
+                to_run.append((i, key))
+            else:
+                followers.append((i, payload))
+        if to_run:
+            t0 = time.perf_counter()
+            try:
+                out = self._execute_many_retry(
+                    idx, [qs[i] for i, _ in to_run], shards)
+            except BaseException as exc:
+                for _, key in to_run:
+                    if key is not None:
+                        cache.fail(key, exc)
+                raise
+            cache.observe_dispatch(time.perf_counter() - t0)
+            for (i, key), res in zip(to_run, out):
+                results[i] = res
+                if key is not None:
+                    cache.complete(key, res)
+        for i, fut in followers:
+            results[i] = copy.deepcopy(fut.result())
+        return results
 
     def _execute_many(self, idx: Index, qs: Sequence[Query],
                       shards) -> List[List[Any]]:
@@ -1211,7 +1300,8 @@ class Executor:
         if compiled is None:
             fn, cols_used, is_red = compile_expr(src)
             compiled = self._apply_cache[src] = (
-                _jax.jit(fn), sorted(cols_used), is_red)
+                platform.guarded_call(_jax.jit(fn)), sorted(cols_used),
+                is_red)
             while len(self._apply_cache) > 64:
                 self._apply_cache.pop(next(iter(self._apply_cache)))
         fn, cols_used, is_red = compiled

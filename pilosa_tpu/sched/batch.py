@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
+from pilosa_tpu.cache.keys import shard_key
 from pilosa_tpu.pql.ast import Call, Query
 
 # Top-level call name -> op family. Families batch together; anything
@@ -66,10 +67,12 @@ def family_of(query: Query) -> str:
 
 def group_key(index: str, query: Query,
               shards: Optional[Sequence[int]] = None) -> GroupKey:
+    # shard canonicalization is shared with the result-cache key
+    # (cache/keys.py shard_key) so the two can never drift; here None
+    # stays None — "all shards at dispatch time" is a stable group.
     return GroupKey(
         index=index,
-        shards=tuple(sorted(int(s) for s in shards))
-        if shards is not None else None,
+        shards=shard_key(shards),
         family=family_of(query),
     )
 

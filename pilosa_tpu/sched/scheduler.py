@@ -50,6 +50,17 @@ class _Pending:
         self.key: GroupKey = group_key(index, query, shards)
 
 
+class _Resolved:
+    """Minimal _Pending stand-in for a cache hit: just a completed
+    future, so ScheduledQuery works unchanged (done() is True, cancel()
+    is False — the "dispatch" already happened)."""
+
+    __slots__ = ("future",)
+
+    def __init__(self, future: Future):
+        self.future = future
+
+
 class ScheduledQuery:
     """Caller-side handle: block on :meth:`result` or :meth:`cancel`."""
 
@@ -130,6 +141,9 @@ class QueryScheduler:
             raise ValueError(
                 "scheduler accepts read-only queries; execute writes "
                 "directly through API.query")
+        hit = self._cache_lookup(index, query, shards)
+        if hit is not None:
+            return hit
         if deadline_ms is None:
             deadline_s = self.default_deadline_s
         else:
@@ -158,6 +172,33 @@ class QueryScheduler:
                                 len(self._queue))
             self._cv.notify_all()
         return ScheduledQuery(pending)
+
+    def _cache_lookup(self, index: str, query: Query,
+                      shards) -> Optional[ScheduledQuery]:
+        """Result-cache hit fast-path: a hit resolves the future
+        immediately and never occupies queue or batch slots. Misses are
+        NOT claimed here — single-flight leadership happens inside the
+        executor, where the group actually dispatches (counting the
+        authoritative miss there too, so this peek never double-counts).
+        """
+        cache = getattr(self.executor, "cache", None)
+        if cache is None:
+            return None
+        key_fn = getattr(self.executor, "cache_key", None)
+        if key_fn is None:
+            return None
+        try:
+            key = key_fn(index, query, shards)
+        except Exception:
+            return None  # unknown index etc.: surface at dispatch
+        if key is None:
+            return None  # executor counts the bypass at dispatch
+        hit, value = cache.lookup(key, count_miss=False)
+        if not hit:
+            return None
+        fut: Future = Future()
+        fut.set_result(value)
+        return ScheduledQuery(_Resolved(fut))
 
     def execute(self, index: str, query: Union[str, Query, Call],
                 shards: Optional[Sequence[int]] = None,

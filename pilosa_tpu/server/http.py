@@ -110,6 +110,10 @@ _ROUTES = [
     ("GET", re.compile(r"^/internal/backup\.tar$"), "get_backup_tar"),
     ("POST", re.compile(r"^/internal/restore$"), "post_restore"),
     ("GET", re.compile(r"^/internal/chksum$"), "get_chksum"),
+    # result cache maintenance (cache/): admin-gated like every
+    # /internal/* route (auth.py ROUTE_LEVELS falls back to admin)
+    ("POST", re.compile(r"^/internal/cache/flush$"), "post_cache_flush"),
+    ("GET", re.compile(r"^/internal/cache/stats$"), "get_cache_stats"),
     # observability (reference: http_handler.go:495-497, :540)
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
@@ -480,6 +484,20 @@ class Handler(BaseHTTPRequestHandler):
 
     def get_chksum(self):
         self._send(200, {"checksum": self.api.checksum()})
+
+    def post_cache_flush(self):
+        cache = getattr(self.api, "cache", None)
+        if cache is None:
+            self._send(200, {"enabled": False, "flushed": 0})
+            return
+        self._send(200, {"enabled": True, "flushed": cache.flush()})
+
+    def get_cache_stats(self):
+        cache = getattr(self.api, "cache", None)
+        if cache is None:
+            self._send(200, {"enabled": False})
+            return
+        self._send(200, {"enabled": True, **cache.stats()})
 
     def get_metrics(self):
         from pilosa_tpu.obs.metrics import REGISTRY

@@ -13,6 +13,7 @@ import io
 import time
 from typing import Any, List, Optional
 
+from pilosa_tpu.cache import keys as cache_keys
 from pilosa_tpu.core.schema import FieldType
 from pilosa_tpu.sql import ast
 from pilosa_tpu.sql.lexer import SQLError
@@ -69,7 +70,7 @@ class SQLEngine:
     def query(self, sql: str, parsed=None) -> SQLResult:
         t0 = time.monotonic()
         stmt = parsed if parsed is not None else parse_statement(sql)
-        res = self._dispatch(stmt)
+        res = self._dispatch(stmt, sql=sql)
         res.exec_ms = (time.monotonic() - t0) * 1000
         return res
 
@@ -83,22 +84,22 @@ class SQLEngine:
 
     # -- statement dispatch ---------------------------------------------------
 
-    def _dispatch(self, stmt) -> SQLResult:
+    def _dispatch(self, stmt, sql: Optional[str] = None) -> SQLResult:
         if isinstance(stmt, ast.SelectStatement):
             if stmt.table in _SYSTEM_TABLES:
                 return self._system_table(stmt)
             self._reject_udf_calls(stmt)
-            sched = getattr(self.api, "scheduler", None)
-            # admission ticket bounds concurrent SELECTs under overload
-            # (the kernel calls inside the plan still micro-batch via the
-            # planner's _read_executor facade)
-            import contextlib
-            admit = sched.admit() if sched is not None else (
-                contextlib.nullcontext())
-            with admit:
-                op = self.planner.plan_select(stmt)
-                return SQLResult(schema=op.schema,
-                                 data=[list(r) for r in op.rows()])
+            cache = getattr(self.api, "cache", None)
+            if cache is not None:
+                key = self._select_cache_key(stmt, sql)
+                if key is None:
+                    cache.bypass()
+                else:
+                    # hits (and single-flight followers) skip the
+                    # admission ticket too — a cached SELECT never
+                    # occupies scheduler slots
+                    return cache.run(key, lambda: self._run_select(stmt))
+            return self._run_select(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateView):
@@ -153,6 +154,43 @@ class SQLEngine:
         if isinstance(stmt, ast.ShowDatabases):
             return SQLResult(schema=[("name", "STRING")], data=[])
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    def _run_select(self, stmt: ast.SelectStatement) -> SQLResult:
+        sched = getattr(self.api, "scheduler", None)
+        # admission ticket bounds concurrent SELECTs under overload
+        # (the kernel calls inside the plan still micro-batch via the
+        # planner's _read_executor facade)
+        import contextlib
+        admit = sched.admit() if sched is not None else (
+            contextlib.nullcontext())
+        with admit:
+            # no dispatch_guard here: the guard is a leaf lock around
+            # each kernel launch (platform.guarded_call) — holding it
+            # across rows(), which on a cluster node fans subtrees out
+            # over loopback HTTP, would starve the serving threads
+            op = self.planner.plan_select(stmt)
+            return SQLResult(schema=op.schema,
+                             data=[list(r) for r in op.rows()])
+
+    def _select_cache_key(self, stmt: ast.SelectStatement,
+                          sql: Optional[str]):
+        """Result-cache key for a plain single-table SELECT, or None.
+        The key is the normalized SQL text + the table's full fragment
+        version fingerprint (a SELECT may touch any field/shard of its
+        table, so the whole table is the conservative read set). Views,
+        joins, derived tables and system tables pass through uncached —
+        their read sets span other objects."""
+        if not sql or not stmt.table or stmt.derived or stmt.joins:
+            return None
+        if stmt.table in _SYSTEM_TABLES or stmt.table in self.views:
+            return None
+        idx = self.api.holder.indexes.get(stmt.table)
+        if idx is None:
+            return None  # let planning raise the usual unknown-table error
+        shard_list = sorted(idx.shards())
+        return ("sql", " ".join(sql.split()), stmt.table,
+                cache_keys.shard_key(shard_list),
+                cache_keys.version_fingerprint(idx, shard_list))
 
     def _create_function(self, cf: ast.CreateFunction) -> SQLResult:
         name = cf.name.lower()  # function names are case-insensitive

@@ -88,6 +88,43 @@ class TestMetrics:
         j = r.as_json()
         assert 'sched_batch_size{family="count"}' in j["histograms"]
 
+    def test_cache_metrics_flow_through_exposition(self):
+        from pilosa_tpu.api import API as _API
+        from pilosa_tpu.obs import metrics as M
+
+        r = MetricsRegistry()
+        api = _API()
+        api.create_index("cm")
+        api.create_field("cm", "f")
+        api.query("cm", "Set(1, f=1)Set(2, f=1)")
+        api.enable_cache(max_entries=1, registry=r)
+        api.query("cm", "Count(Row(f=1))")  # miss + insert
+        api.query("cm", "Count(Row(f=1))")  # hit
+        api.query("cm", "Row(f=1)")  # miss, evicts the Count entry
+        api.query("cm", "Options(Row(f=1), shards=[0])")  # bypass
+        api.disable_cache()
+        assert r.value(M.METRIC_CACHE_HITS) == 1
+        assert r.value(M.METRIC_CACHE_MISSES) == 2
+        assert r.value(M.METRIC_CACHE_BYPASS) == 1
+        assert r.value(M.METRIC_CACHE_EVICTIONS, reason="entries") == 1
+        assert r.value(M.METRIC_CACHE_ENTRIES) == 1
+        assert r.value(M.METRIC_CACHE_BYTES) > 0
+        text = r.prometheus_text()
+        assert "pilosa_cache_hits_total 1" in text
+        assert "pilosa_cache_misses_total 2" in text
+        assert "pilosa_cache_bypass_total 1" in text
+        assert 'pilosa_cache_evictions_total{reason="entries"} 1' in text
+        assert "# TYPE pilosa_cache_resident_bytes gauge" in text
+        # both latency histograms expose the shared bucket layout
+        assert "# TYPE pilosa_cache_hit_seconds histogram" in text
+        assert 'pilosa_cache_hit_seconds_bucket{le="+Inf"} 1' in text
+        assert "# TYPE pilosa_cache_dispatch_seconds histogram" in text
+        assert "pilosa_cache_dispatch_seconds_count 2" in text
+        j = r.as_json()
+        assert "cache_hit_seconds" in j["histograms"]
+        assert "cache_dispatch_seconds" in j["histograms"]
+        assert j["counters"]["cache_hits_total"] == 1
+
     def test_api_instruments(self):
         base = REGISTRY.value("pql_queries_total")
         api = API()
