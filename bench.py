@@ -470,6 +470,104 @@ def bench_config7(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 8 — cross-shard-set fusion: random subsets, fused vs unfused
+# ---------------------------------------------------------------------------
+
+def bench_config8(device: str) -> None:
+    """32 concurrent count queries over random 4-of-8 shard subsets.
+    Without superset fusion nearly every subset is its own GroupKey, so
+    the micro-batcher degrades to ~32 serialized dispatches; with
+    fusion (sched/scheduler.py superset merge + pql/executor.py shard
+    masks) overlapping subsets pad onto one union stack and the whole
+    wave collapses to a couple of dispatches. Both paths are oracle-
+    checked against numpy, so the masked results are provably
+    bit-identical to unfused execution."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.obs.metrics import MetricsRegistry
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(8)
+    n_shards, per_shard = 8, _n(200_000)
+    api = API()
+    api.create_index("c8")
+    api.create_field("c8", "city")
+    api.create_field("c8", "device")
+    city_by_shard, dev_by_shard = [], []
+    for shard in range(n_shards):
+        base = shard * SHARD_WIDTH
+        city = rng.integers(0, 50, per_shard)
+        dev = rng.integers(0, 10, per_shard)
+        cols = base + np.arange(per_shard)
+        api.import_bits("c8", "city", rows=city, cols=cols)
+        api.import_bits("c8", "device", rows=dev, cols=cols)
+        city_by_shard.append(city)
+        dev_by_shard.append(dev)
+
+    nq = 32
+    subsets = [sorted(rng.choice(n_shards, size=4, replace=False).tolist())
+               for _ in range(nq)]
+    queries = [f"Count(Intersect(Row(city={i % 50}), Row(device={i % 10})))"
+               for i in range(nq)]
+    # numpy oracle over each query's OWN subset: ground truth for both
+    # the unfused and the masked-superset path
+    want = [int(sum(np.sum((city_by_shard[s] == i % 50)
+                           & (dev_by_shard[s] == i % 10))
+                    for s in subsets[i]))
+            for i in range(nq)]
+    # warm both stacked widths (4-shard subset + 8-shard union) so the
+    # timed phases measure dispatch, not XLA compiles
+    api.query("c8", queries[0], shards=subsets[0])
+    api.executor.execute_many("c8", queries[:2],
+                              per_query_shards=subsets[:2])
+
+    def timed(i):
+        t0 = time.perf_counter()
+        r = api.query("c8", queries[i], shards=subsets[i])[0]
+        return r, time.perf_counter() - t0
+
+    def run_wave(fuse_waste_ratio):
+        reg = MetricsRegistry()
+        api.enable_scheduler(window_ms=2.0, max_batch=nq,
+                             fuse_waste_ratio=fuse_waste_ratio,
+                             registry=reg)
+        try:
+            with ThreadPoolExecutor(nq) as pool:
+                t0 = time.perf_counter()
+                out = list(pool.map(timed, range(nq)))
+                wall = time.perf_counter() - t0
+        finally:
+            api.disable_scheduler()
+        assert [r for r, _ in out] == want  # bit-identical to the oracle
+        counters = reg.as_json()["counters"]
+        dispatches = sum(v for k, v in counters.items()
+                         if k.startswith("sched_batches_total"))
+        merges = sum(v for k, v in counters.items()
+                     if k.startswith("sched_superset_merges_total"))
+        return sorted(s for _, s in out), wall, dispatches, merges
+
+    def pct(lat, p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    # unfused: waste ratio 0 disables superset merging; only exact
+    # same-subset queries may still share a dispatch
+    off_lat, off_wall, off_disp, _ = run_wave(0.0)
+    on_lat, on_wall, on_disp, on_merges = run_wave(2.0)
+
+    on_p50 = pct(on_lat, 0.5)
+    _emit(f"c8_fused_subset_p50_32q_4of8{SCALED} ({device})", on_p50,
+          "ms", pct(off_lat, 0.5) / max(on_p50, 1e-6),
+          p50_unfused_ms=pct(off_lat, 0.5), p99_ms=pct(on_lat, 0.99),
+          p99_unfused_ms=pct(off_lat, 0.99),
+          dispatches_fused=on_disp, dispatches_unfused=off_disp,
+          superset_merges=on_merges,
+          wall_fused_s=on_wall, wall_unfused_s=off_wall,
+          qps_fused=nq / on_wall, qps_unfused=nq / off_wall,
+          floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -617,6 +715,7 @@ _CONFIGS = {
     "5": bench_config5,
     "6": bench_config6,
     "7": bench_config7,
+    "8": bench_config8,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -69,8 +69,9 @@ class API:
         """Route concurrent reads through a micro-batching scheduler
         (amortizes the per-dispatch floor). ``config`` is a
         pilosa_tpu.config.Config; kwargs override individual knobs
-        (window_ms, max_batch, max_queue, default_deadline_ms, clock,
-        registry)."""
+        (window_ms, max_batch, max_queue, default_deadline_ms,
+        fuse_waste_ratio, adaptive_window, window_min_ms, window_max_ms,
+        clock, registry)."""
         from pilosa_tpu.sched import QueryScheduler
 
         if self.scheduler is not None:

@@ -43,6 +43,21 @@ def shard_key(shards: Optional[Sequence[int]],
     return tuple(sorted(int(s) for s in shards))
 
 
+def union_shards(shard_sets: Iterable[Optional[Sequence[int]]]
+                 ) -> Optional[Tuple[int, ...]]:
+    """Sorted union of canonical shard sets — the superset layout a
+    fused cross-shard-set dispatch stacks over (sched/ superset
+    fusion). Any unresolved set (None = "all shards at dispatch time")
+    poisons the union: the caller has no holder access to expand it, so
+    such groups never merge with explicit ones."""
+    out: set = set()
+    for s in shard_sets:
+        if s is None:
+            return None
+        out.update(int(x) for x in s)
+    return tuple(sorted(out))
+
+
 def version_fingerprint(idx, shard_list: Sequence[int]) -> Tuple:
     """Tuple of (field, view, shard, version) for every fragment of the
     index over ``shard_list`` — a conservative superset of the fragments
@@ -95,7 +110,14 @@ def query_cache_key(idx, query, shard_list: Sequence[int],
     frozen shard set, version fingerprint)`` — or None when the query is
     not cacheable. ``namespace`` separates result dialects that would
     otherwise collide (a remote=True executor returns untranslated,
-    untruncated partials for the same PQL text)."""
+    untruncated partials for the same PQL text).
+
+    ``shard_list`` is the query's OWN resolved shard set even when it
+    executes masked over a superset stack (executor per_query_shards):
+    a superset-fused dispatch fills exact per-query entries, keyed and
+    version-fingerprinted over just the shards the result depends on —
+    so partially-overlapping workloads warm each other, and a write to
+    a union-only shard never invalidates a subset query's entry."""
     if not is_cacheable(query):
         return None
     pql = query.to_pql()

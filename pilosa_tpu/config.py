@@ -103,6 +103,16 @@ class Config:
     scheduler_max_batch: int = 64  # queries fused per dispatch
     scheduler_max_queue: int = 1024  # admission bound (429 beyond)
     scheduler_default_deadline_ms: float = 0.0  # <=0: no deadline
+    # cross-shard-set superset fusion: groups whose shard sets overlap
+    # merge into one padded/masked dispatch when
+    # |union| / max(|subset|) <= fuse-waste-ratio; <=0 disables merging
+    scheduler_fuse_waste_ratio: float = 2.0
+    # adaptive batching window: derive the window from an EWMA of the
+    # observed arrival rate (short when idle, longer under load),
+    # clamped to [window-min-ms, window-max-ms]
+    scheduler_adaptive_window: bool = False
+    scheduler_window_min_ms: float = 0.2
+    scheduler_window_max_ms: float = 5.0
     # result cache ([cache] section / PILOSA_TPU_CACHE_*): version-keyed
     # read result caching + single-flight dedup (cache/)
     cache_enabled: bool = False

@@ -52,6 +52,18 @@ METRIC_SCHED_BATCHES = "sched_batches_total"
 METRIC_SCHED_QUERIES = "sched_queries_total"
 # batch-size buckets: powers of two up to the default max_batch
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+# superset fusion (sched/ cross-shard-set merging): queries that rode a
+# merged (padded/masked) dispatch, shard-set groups folded into another
+# group's dispatch, and the padding-waste ratio |union| / max(|subset|)
+# each merged dispatch paid for its amortization
+METRIC_SCHED_FUSED_QUERIES = "sched_fused_queries_total"
+METRIC_SCHED_SUPERSET_MERGES = "sched_superset_merges_total"
+METRIC_SCHED_PADDING_WASTE = "sched_padding_waste_ratio"  # histogram
+METRIC_SCHED_WINDOW_MS = "sched_window_ms"  # gauge (adaptive sizing)
+# waste-ratio buckets: 1.0 = zero padding (identical sets); the default
+# fuse-waste-ratio gate (2.0) sits mid-range so both admitted and
+# hypothetical overflow land visibly
+PADDING_WASTE_BUCKETS = (1.0, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0)
 # result cache (cache/): version-keyed read caching + single-flight
 METRIC_CACHE_HITS = "cache_hits_total"
 METRIC_CACHE_MISSES = "cache_misses_total"

@@ -54,6 +54,23 @@ def plane_to_bits(plane) -> np.ndarray:
     return native.plane_to_bits(np.asarray(plane, dtype="<u4"))
 
 
+def shard_mask_plane(shard_list, subset, words: int = WORDS_PER_SHARD
+                     ) -> np.ndarray:
+    """Word-lane mask over a stacked layout: ``uint32[S*W]`` with
+    0xFFFFFFFF on the words of shards in ``subset`` and 0 elsewhere.
+
+    This is the [S] per-query 0/1 shard vector of superset fusion
+    (pql/executor.py ShardMask) broadcast to word granularity — shards
+    are whole multiples of WORDS_PER_SHARD in the stacked axis, so a
+    shard-level mask never splits a word and ``plane & mask`` restricts
+    any column-reducing kernel to exactly the subset's columns.
+    """
+    sel = np.fromiter((s in subset for s in shard_list), dtype=bool,
+                      count=len(shard_list))
+    full = np.where(sel, np.uint32(0xFFFFFFFF), np.uint32(0))
+    return np.repeat(full, words).astype(np.uint32)
+
+
 # ---------------------------------------------------------------------------
 # Boolean algebra (device)
 # ---------------------------------------------------------------------------

@@ -190,6 +190,24 @@ def encode_values(cols, values, depth: int, words: int) -> np.ndarray:
     return planes
 
 
+def mask_filter(filt, mask_plane):
+    """Combine an optional row-filter plane with an optional shard-
+    subset mask plane (superset fusion, pql/executor.py ShardMask).
+
+    Every aggregate/rank kernel here and in ops/bitmap.py takes a
+    ``filt`` plane it ANDs against candidates first, so a per-query
+    shard mask threads through the existing L0 signatures as
+    ``filt & mask`` — no kernel recompiles, no new tracing axes. With
+    no filter the mask IS the filter (restricting exists/candidates to
+    the subset's columns); with no mask the filter passes unchanged.
+    """
+    if mask_plane is None:
+        return filt
+    if filt is None:
+        return mask_plane
+    return jnp.bitwise_and(filt, mask_plane)
+
+
 # ---------------------------------------------------------------------------
 # Aggregates
 # ---------------------------------------------------------------------------

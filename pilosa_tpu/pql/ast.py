@@ -100,6 +100,20 @@ class Call:
 ROW_OPTIONS = frozenset({"from", "to"})
 
 
+def unwrap_options(call: "Call") -> "Call":
+    """The innermost non-Options call. ``Options(...)`` is a transparent
+    execution wrapper — the executor applies its args and evaluates the
+    child — so anything classifying a call by name (scheduler op-family
+    grouping, fusion maskability) must look through every layer; the one
+    shared unwrap keeps those classifications from drifting. Note the
+    wrapper's ARGS still matter to callers: an ``Options(shards=...)``
+    override re-scopes the child, which both the result cache
+    (cache/keys.py is_cacheable) and superset fusion must respect."""
+    while call.name == "Options" and call.children:
+        call = call.children[0]
+    return call
+
+
 @dataclasses.dataclass
 class Query:
     calls: List[Call]

@@ -39,7 +39,7 @@ from pilosa_tpu.core.stacked import StackedBSI, StackedSet, stacked_bsi, stacked
 from pilosa_tpu.ops import bitmap as B
 from pilosa_tpu.ops import bsi as S
 from pilosa_tpu.ops.groupby import pair_counts, pair_sums
-from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS
+from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS, unwrap_options
 from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.pql import result as R
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
@@ -56,6 +56,56 @@ _BITMAP_CALLS = {"Row", "Union", "Intersect", "Difference", "Xor", "Not",
                  "All", "ConstRow", "UnionRows", "Shift", "Distinct", "Limit"}
 
 _WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store", "Delete"}
+
+# Calls whose results stay exact under a per-query shard mask over a
+# union stacked layout (superset fusion). Every shard's segment of a
+# bitmap expression depends only on that shard's fragments (all plane
+# algebra is column-local; Shift carries stop at shard boundaries), so
+# masking the columns a reduction sees is equivalent to evaluating over
+# the subset's own stack. Host-scan calls (Extract/Apply/Arrow/Sort/...)
+# walk fragments directly and are excluded — they run with their own
+# shard list instead.
+_MASKABLE_CALLS = (_BITMAP_CALLS
+                   | {"Count", "Sum", "Min", "Max", "Percentile",
+                      "TopN", "TopK", "Rows", "GroupBy"})
+
+
+def query_maskable(query) -> bool:
+    """True when every top-level call of ``query`` can execute under a
+    per-query shard mask (see _MASKABLE_CALLS). ``Options`` wrappers are
+    transparent UNLESS they carry a ``shards=`` override: that re-scopes
+    the call away from the union layout the mask indexes, so such
+    queries keep their own shard list (the result cache excludes them
+    for the same reason, cache/keys.py is_cacheable)."""
+    calls = query.calls if isinstance(query, Query) else [query]
+    for call in calls:
+        while call.name == "Options" and call.children:
+            if call.arg("shards") is not None:
+                return False
+            call = call.children[0]
+        if call.name not in _MASKABLE_CALLS:
+            return False
+    return True
+
+
+class ShardMask:
+    """Per-query shard-subset mask over a union stacked layout (superset
+    fusion, sched/batch.py): a ``uint32[S*W]`` word plane with all-ones
+    words on the query's own shards and zeros elsewhere
+    (ops/bitmap.py shard_mask_plane).
+
+    Applied at materialization/aggregation points only — bitmap algebra
+    (AND/OR/XOR/ANDNOT) distributes over a per-column mask, so masking
+    the final plane equals masking every leaf, and the intermediate
+    evaluation stays shared across the whole fused batch."""
+
+    __slots__ = ("shard_list", "subset", "plane")
+
+    def __init__(self, shard_list: Sequence[int], subset):
+        self.shard_list = [int(s) for s in shard_list]
+        self.subset = frozenset(int(s) for s in subset)
+        self.plane = jnp.asarray(
+            B.shard_mask_plane(self.shard_list, self.subset))
 
 
 def has_write_calls(query) -> bool:
@@ -200,14 +250,28 @@ class Executor:
         _start_copies(raw)
         return [_resolve(r) for r in raw]
 
+    # Capability flag for the scheduler's superset fusion (sched/batch.py
+    # probes it before routing heterogeneous shard sets here).
+    supports_shard_masks = True
+
     def execute_many(self, index: str, queries: Sequence,
-                     shards: Optional[Sequence[int]] = None
+                     shards: Optional[Sequence[int]] = None,
+                     per_query_shards: Optional[Sequence] = None
                      ) -> List[List[Any]]:
         """Resolve several read queries with ONE blocking device->host
         sync — the fusion primitive behind the micro-batcher (sched/):
         every call of every query dispatches asynchronously, then all
         copies overlap, so N concurrent queries pay one round-trip floor
-        exactly like N top-level calls of a single ``execute``."""
+        exactly like N top-level calls of a single ``execute``.
+
+        ``per_query_shards`` (one shard set per query, overriding
+        ``shards``) enables CROSS-shard-set fusion: maskable queries
+        evaluate over ONE stacked layout covering the union of all sets,
+        each restricted to its own subset by a per-query word-lane mask
+        (ShardMask) — still one dispatch + one host sync. Queries the
+        mask cannot cover exactly (host-scan calls, Options shards=
+        overrides) keep their own shard list within the same fused
+        round."""
         idx = self.holder.index(index)
         qs: List[Query] = []
         for q in queries:
@@ -218,37 +282,84 @@ class Executor:
             if has_write_calls(q):
                 raise ValueError("execute_many is read-only")
             qs.append(q)
+        if per_query_shards is None:
+            if self.cache is None:
+                return self._execute_many_retry(idx, qs, shards)
+            return self._execute_many_cached(idx, qs, shards)
+        if len(per_query_shards) != len(qs):
+            raise ValueError("per_query_shards must match queries")
+        shard_lists = [self._shards(idx, s) for s in per_query_shards]
         if self.cache is None:
-            return self._execute_many_retry(idx, qs, shards)
-        return self._execute_many_cached(idx, qs, shards)
+            plans = self._fusion_plans(idx, qs, shard_lists)
+            return self._execute_many_retry(idx, qs, shards, plans)
+        return self._execute_many_cached(idx, qs, shards, shard_lists)
+
+    def _fusion_plans(self, idx: Index, qs: Sequence[Query],
+                      shard_lists: Sequence[List[int]]
+                      ) -> List[Tuple[List[int], Optional[ShardMask]]]:
+        """Per-query (shard_list, mask) execution plans over the union
+        layout. Plans are pure host data — safe to reuse across
+        StackStale retries. Masks for identical subsets are shared (one
+        mask plane per distinct subset, not per query)."""
+        union = sorted(set().union(*map(set, shard_lists))) \
+            if shard_lists else []
+        union_set = set(union)
+        masks: Dict[frozenset, ShardMask] = {}
+        plans: List[Tuple[List[int], Optional[ShardMask]]] = []
+        for q, sl in zip(qs, shard_lists):
+            sub = frozenset(sl)
+            if sub == union_set:
+                plans.append((union, None))
+            elif query_maskable(q):
+                mask = masks.get(sub)
+                if mask is None:
+                    mask = masks[sub] = ShardMask(union, sub)
+                plans.append((union, mask))
+            else:
+                plans.append((sl, None))
+        return plans
 
     def _execute_many_retry(self, idx: Index, qs: Sequence[Query],
-                            shards) -> List[List[Any]]:
+                            shards, plans=None) -> List[List[Any]]:
         from pilosa_tpu.core.stacked import StackStale
 
-        # same StackStale retry contract as _execute_read
+        # same StackStale retry contract as _execute_read (plans are
+        # pure host data, safe to reuse across retries)
         for _ in range(3):
             try:
-                return self._execute_many(idx, qs, shards)
+                if plans is None:
+                    return self._execute_many(idx, qs, shards)
+                return self._execute_many(idx, qs, shards, plans)
             except StackStale:
                 continue
         with self.holder.write_lock:
-            return self._execute_many(idx, qs, shards)
+            if plans is None:
+                return self._execute_many(idx, qs, shards)
+            return self._execute_many(idx, qs, shards, plans)
 
     def _execute_many_cached(self, idx: Index, qs: Sequence[Query],
-                             shards) -> List[List[Any]]:
+                             shards, shard_lists=None) -> List[List[Any]]:
         """Per-query cache fill around ONE fused dispatch: hits and
         single-flight followers drop out of the batch; all remaining
         queries (miss leaders + uncacheable bypasses) still go through
-        a single ``_execute_many`` so the fusion amortization is kept."""
+        a single ``_execute_many`` so the fusion amortization is kept.
+
+        With ``shard_lists`` (superset fusion), each query's key uses its
+        OWN shard set — a masked execution over the union stack fills
+        exact per-query entries, and the fusion plan for the residual
+        misses is recomputed over just their (possibly tighter) union."""
         cache = self.cache
-        shard_list = self._shards(idx, shards)
+        if shard_lists is None:
+            shared = self._shards(idx, shards)
+            key_lists = [shared] * len(qs)
+        else:
+            key_lists = shard_lists
         ns = "remote" if self.remote else "local"
         results: List[Optional[List[Any]]] = [None] * len(qs)
         to_run: List[Tuple[int, Optional[Tuple]]] = []  # (slot, key|None)
         followers = []  # (slot, future)
         for i, q in enumerate(qs):
-            key = query_cache_key(idx, q, shard_list, namespace=ns)
+            key = query_cache_key(idx, q, key_lists[i], namespace=ns)
             if key is None:
                 cache.bypass()
                 to_run.append((i, None))
@@ -261,10 +372,14 @@ class Executor:
             else:
                 followers.append((i, payload))
         if to_run:
+            run_qs = [qs[i] for i, _ in to_run]
+            plans = None
+            if shard_lists is not None:
+                plans = self._fusion_plans(
+                    idx, run_qs, [key_lists[i] for i, _ in to_run])
             t0 = time.perf_counter()
             try:
-                out = self._execute_many_retry(
-                    idx, [qs[i] for i, _ in to_run], shards)
+                out = self._execute_many_retry(idx, run_qs, shards, plans)
             except BaseException as exc:
                 for _, key in to_run:
                     if key is not None:
@@ -280,35 +395,54 @@ class Executor:
         return results
 
     def _execute_many(self, idx: Index, qs: Sequence[Query],
-                      shards) -> List[List[Any]]:
-        raw = [[self._execute_call(idx, call, shards) for call in q.calls]
-               for q in qs]
+                      shards, plans=None) -> List[List[Any]]:
+        if plans is None:
+            raw = [[self._execute_call(idx, call, shards) for call in q.calls]
+                   for q in qs]
+        else:
+            raw = [[self._execute_call(idx, call, s, mask)
+                    for call in q.calls]
+                   for q, (s, mask) in zip(qs, plans)]
         for rq in raw:
             _start_copies(rq)
         return [[_resolve(r) for r in rq] for rq in raw]
 
     # -- dispatch (reference: executor.go:679 executeCall) --------------------
 
-    def _execute_call(self, idx: Index, call: Call, shards=None) -> Any:
+    def _execute_call(self, idx: Index, call: Call, shards=None,
+                      mask: Optional[ShardMask] = None) -> Any:
         name = call.name
         if name == "Options":
             if call.arg("shards") is not None:
+                if mask is not None:
+                    # query_maskable excludes these before planning; a
+                    # mask sized for the union layout cannot index an
+                    # arbitrary override set.
+                    raise PQLError(
+                        "Options(shards=) cannot execute under a shard mask")
                 shards = [int(s) for s in call.arg("shards")]
-            return self._execute_call(idx, call.children[0], shards)
+            return self._execute_call(idx, call.children[0], shards, mask)
         if name in _WRITE_CALLS:
             return self._execute_write(idx, call, shards)
         if name == "Count":
-            return self._execute_count(idx, call, shards)
+            return self._execute_count(idx, call, shards, mask)
         if name in ("Sum", "Min", "Max"):
-            return self._execute_bsi_agg(idx, call, shards)
+            return self._execute_bsi_agg(idx, call, shards, mask)
         if name in ("TopN", "TopK"):
-            return self._execute_topn(idx, call, shards)
+            return self._execute_topn(idx, call, shards, mask)
         if name == "Rows":
-            return self._execute_rows(idx, call, shards)
+            return self._execute_rows(idx, call, shards, mask)
         if name == "GroupBy":
-            return self._execute_groupby(idx, call, shards)
+            return self._execute_groupby(idx, call, shards, mask)
         if name == "Percentile":
-            return self._execute_percentile(idx, call, shards)
+            return self._execute_percentile(idx, call, shards, mask)
+        if name in _BITMAP_CALLS:
+            return self._materialize_row(idx, call, shards, mask)
+        if mask is not None:
+            # host-scan calls walk fragments directly; _MASKABLE_CALLS
+            # keeps them out of masked plans — reaching here means a
+            # caller bypassed query_maskable.
+            raise PQLError(f"{name} cannot execute under a shard mask")
         if name == "IncludesColumn":
             return self._execute_includes_column(idx, call)
         if name == "Extract":
@@ -323,8 +457,6 @@ class Executor:
             return self._execute_field_value(idx, call)
         if name == "ExternalLookup":
             return self._execute_external_lookup(idx, call)
-        if name in _BITMAP_CALLS:
-            return self._materialize_row(idx, call, shards)
         raise PQLError(f"unknown call {name!r}")
 
     # -- shard helpers ---------------------------------------------------------
@@ -379,14 +511,20 @@ class Executor:
     # The analog of executor.go:1782 executeBitmapCallShard, but over ALL
     # shards at once: planes are uint32[len(shards)*WORDS_PER_SHARD].
 
-    def _eval_all(self, idx: Index, call: Call, shard_list: List[int]
-                  ) -> jnp.ndarray:
+    def _eval_all(self, idx: Index, call: Call, shard_list: List[int],
+                  mask: Optional[ShardMask] = None) -> jnp.ndarray:
+        # ``mask`` does NOT restrict the planes built here — bitmap
+        # algebra is column-local, so callers mask once at their
+        # materialization/aggregation point. It threads through only for
+        # the restricted-Rows selection below (limit/previous/column pick
+        # DIFFERENT rows depending on which columns count as present).
         total_words = len(shard_list) * WORDS_PER_SHARD
         name = call.name
         if name == "Row":
             return self._eval_row(idx, call, shard_list)
         if name == "Union":
-            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
+            planes = [self._eval_all(idx, c, shard_list, mask)
+                      for c in call.children]
             out = planes[0] if planes else self._zero(total_words)
             for p in planes[1:]:
                 out = B.plane_or(out, p)
@@ -394,7 +532,8 @@ class Executor:
         if name == "Intersect":
             if not call.children:
                 raise PQLError("Intersect requires at least one child")
-            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
+            planes = [self._eval_all(idx, c, shard_list, mask)
+                      for c in call.children]
             out = planes[0]
             for p in planes[1:]:
                 out = B.plane_and(out, p)
@@ -402,18 +541,20 @@ class Executor:
         if name == "Difference":
             if not call.children:
                 raise PQLError("Difference requires at least one child")
-            out = self._eval_all(idx, call.children[0], shard_list)
+            out = self._eval_all(idx, call.children[0], shard_list, mask)
             for c in call.children[1:]:
-                out = B.plane_andnot(out, self._eval_all(idx, c, shard_list))
+                out = B.plane_andnot(
+                    out, self._eval_all(idx, c, shard_list, mask))
             return out
         if name == "Xor":
-            planes = [self._eval_all(idx, c, shard_list) for c in call.children]
+            planes = [self._eval_all(idx, c, shard_list, mask)
+                      for c in call.children]
             out = planes[0] if planes else self._zero(total_words)
             for p in planes[1:]:
                 out = B.plane_xor(out, p)
             return out
         if name == "Not":
-            child = self._eval_all(idx, call.children[0], shard_list)
+            child = self._eval_all(idx, call.children[0], shard_list, mask)
             return B.plane_andnot(self._existence_all(idx, shard_list), child)
         if name == "All":
             return self._existence_all(idx, shard_list)
@@ -451,7 +592,7 @@ class Executor:
                                   or c.arg("column") is not None)
                     # _rows_list honors from/to together with the
                     # limit/previous/column options
-                    rows = (self._rows_list(idx, c, shard_list)
+                    rows = (self._rows_list(idx, c, shard_list, mask)
                             if restricted else None)
                     for v in views:
                         st = stacked_set(field, shard_list, v)
@@ -463,11 +604,11 @@ class Executor:
                         and c.arg("column") is None):
                     rows = st.row_ids  # empty rows OR in nothing
                 else:
-                    rows = self._rows_list(idx, c, shard_list)
+                    rows = self._rows_list(idx, c, shard_list, mask)
                 out = B.plane_or(out, st.rows_plane(rows))
             return out
         if name == "Shift":
-            out = self._eval_all(idx, call.children[0], shard_list)
+            out = self._eval_all(idx, call.children[0], shard_list, mask)
             shaped = out.reshape(len(shard_list), WORDS_PER_SHARD)
             for _ in range(int(call.arg("n", 1))):
                 # carries stop at shard boundaries, matching the
@@ -530,7 +671,8 @@ class Executor:
 
     # -- top-level materialization --------------------------------------------
 
-    def _materialize_row(self, idx: Index, call: Call, shards) -> Any:
+    def _materialize_row(self, idx: Index, call: Call, shards,
+                         mask: Optional[ShardMask] = None) -> Any:
         limit, offset = None, 0
         if call.name == "Limit":
             limit = call.arg("limit")
@@ -539,11 +681,14 @@ class Executor:
             if self.remote:  # coordinator applies limit/offset after merge
                 limit, offset = None, 0
         if call.name == "Distinct":
-            return self._execute_distinct(idx, call, shards)
+            return self._execute_distinct(idx, call, shards, mask)
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return self._row_result(idx, [])
-        plane = self._eval_all(idx, call, shard_list)
+        plane = self._eval_all(idx, call, shard_list, mask)
+        if mask is not None:
+            # restrict materialized columns to the query's own shards
+            plane = B.plane_and(plane, mask.plane)
 
         def finalize(plane_np: np.ndarray):
             shaped = plane_np.reshape(len(shard_list), WORDS_PER_SHARD)
@@ -567,30 +712,40 @@ class Executor:
 
     # -- Count (reference: executor.go:5839 executeCount) ---------------------
 
-    def _execute_count(self, idx: Index, call: Call, shards) -> Any:
+    def _execute_count(self, idx: Index, call: Call, shards,
+                       mask: Optional[ShardMask] = None) -> Any:
         if len(call.children) != 1:
             raise PQLError("Count requires a single child call")
         child = call.children[0]
         if child.name == "Distinct":
-            res = _resolve(self._execute_distinct(idx, child, shards))
+            res = _resolve(self._execute_distinct(idx, child, shards, mask))
             if isinstance(res, R.RowResult):
                 return len(res.columns or res.keys or [])
             return len(res)
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return 0
-        count = B.plane_count(self._eval_all(idx, child, shard_list))
+        plane = self._eval_all(idx, child, shard_list, mask)
+        if mask is None:
+            count = B.plane_count(plane)
+        else:
+            # fused AND+popcount — the mask never materializes on host
+            count = B.plane_intersection_count(plane, mask.plane)
         return _Deferred([count], lambda c: int(c))
 
     # -- BSI aggregates (reference: executor.go executeSum/Min/Max) -----------
 
     def _agg_filter(self, idx: Index, call: Call, shard_list: List[int],
-                    st: StackedBSI) -> jnp.ndarray:
+                    st: StackedBSI, mask: Optional[ShardMask] = None
+                    ) -> jnp.ndarray:
         if call.children:
-            return self._eval_all(idx, call.children[0], shard_list)
-        return st.exists_plane()
+            filt = self._eval_all(idx, call.children[0], shard_list, mask)
+        else:
+            filt = st.exists_plane()
+        return S.mask_filter(filt, mask.plane if mask is not None else None)
 
-    def _execute_bsi_agg(self, idx: Index, call: Call, shards) -> Any:
+    def _execute_bsi_agg(self, idx: Index, call: Call, shards,
+                         mask: Optional[ShardMask] = None) -> Any:
         fname = call.arg("field") or call.arg("_field")
         if fname is None:
             raise PQLError(f"{call.name} requires field=")
@@ -602,7 +757,7 @@ class Executor:
             if not shard_list:
                 return R.ValCount(val=0, count=0)
             st = stacked_bsi(field, shard_list)
-            filt = self._agg_filter(idx, call, shard_list, st)
+            filt = self._agg_filter(idx, call, shard_list, st, mask)
             count, pos, neg = S.bsi_plane_popcounts(st.planes, filt)
 
             def fin_sum(count_np, pos_np, neg_np):
@@ -623,7 +778,7 @@ class Executor:
             return R.ValCount(val=None, count=0)
         want_max = call.name == "Max"
         st = stacked_bsi(field, shard_list)
-        filt = self._agg_filter(idx, call, shard_list, st)
+        filt = self._agg_filter(idx, call, shard_list, st, mask)
         bits, negative, cnt, total = S._minmax_kernel(st.planes, filt, want_max)
 
         def fin_minmax(bits_np, neg_np, cnt_np, total_np):
@@ -641,15 +796,20 @@ class Executor:
 
     # -- TopN / TopK (reference: executor.go:2357/2535) ------------------------
 
-    def _execute_topn(self, idx: Index, call: Call, shards) -> Any:
+    def _execute_topn(self, idx: Index, call: Call, shards,
+                      mask: Optional[ShardMask] = None) -> Any:
         fname = self._field_name(call)
         field = idx.field(fname)
         n = call.arg("n") or call.arg("k")
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return self._pairs_field(field, [])
-        filt = (self._eval_all(idx, call.children[0], shard_list)
+        filt = (self._eval_all(idx, call.children[0], shard_list, mask)
                 if call.children else None)
+        if mask is not None:
+            # rank only the subset's columns; zero-count rows drop in
+            # finalize, matching a solo run over the subset
+            filt = S.mask_filter(filt, mask.plane)
         row_ids, counts = self._ranged_row_counts(field, call, shard_list,
                                                   filt)
         if not row_ids:
@@ -722,7 +882,8 @@ class Executor:
             raise PQLError(f"{call.name} requires a field")
         return fname
 
-    def _rows_list(self, idx: Index, call: Call, shards=None) -> List[int]:
+    def _rows_list(self, idx: Index, call: Call, shards=None,
+                   mask: Optional[ShardMask] = None) -> List[int]:
         field = idx.field(self._field_name(call))
         col = call.arg("column")
         shard_list = self._shards(idx, shards)
@@ -730,7 +891,8 @@ class Executor:
         if col is not None:
             # point lookup: host planes, no device trip
             c = self._col_id(idx, col)
-            if c is not None and c // SHARD_WIDTH in shard_list:
+            if (c is not None and c // SHARD_WIDTH in shard_list
+                    and (mask is None or c // SHARD_WIDTH in mask.subset)):
                 shard = c // SHARD_WIDTH
                 frag = field.fragment(shard)
                 if frag is not None:
@@ -740,9 +902,13 @@ class Executor:
                         if plane[pos // 32] & (np.uint32(1) << np.uint32(pos % 32)):
                             rows.add(row)
         elif shard_list:
-            # honors from/to time args (reference: executor.go:4108)
+            # honors from/to time args (reference: executor.go:4108). A
+            # shard mask rides in as the count filter: rows present only
+            # outside the subset count zero and drop out, so the listing
+            # (and the limit/previous cut below) matches a solo run.
             row_ids, counts = self._ranged_row_counts(
-                field, call, shard_list, None)
+                field, call, shard_list,
+                mask.plane if mask is not None else None)
             if row_ids:
                 counts = np.asarray(counts)
                 rows = {row for slot, row in enumerate(row_ids)
@@ -757,9 +923,10 @@ class Executor:
             out = out[: int(limit)]
         return out
 
-    def _execute_rows(self, idx: Index, call: Call, shards) -> List[Any]:
+    def _execute_rows(self, idx: Index, call: Call, shards,
+                      mask: Optional[ShardMask] = None) -> List[Any]:
         field = idx.field(self._field_name(call))
-        rows = self._rows_list(idx, call, shards)
+        rows = self._rows_list(idx, call, shards, mask)
         if field.options.keys and not self.remote:
             m = field.translate.translate_ids(rows)
             return [m.get(r, str(r)) for r in rows]
@@ -767,11 +934,12 @@ class Executor:
 
     # -- Distinct (reference: executor.go:1952-2153) ---------------------------
 
-    def _execute_distinct(self, idx: Index, call: Call, shards):
+    def _execute_distinct(self, idx: Index, call: Call, shards,
+                          mask: Optional[ShardMask] = None):
         field = idx.field(self._field_name(call))
         if not field.options.type.is_bsi:
             # Set-like: distinct values are the row IDs present.
-            rows = self._rows_list(idx, call, shards)
+            rows = self._rows_list(idx, call, shards, mask)
             if field.options.keys and not self.remote:
                 m = field.translate.translate_ids(rows)
                 return R.RowResult(columns=[], keys=[m.get(r, str(r)) for r in rows])
@@ -780,10 +948,12 @@ class Executor:
         filt_np = None
         if call.children and shard_list:
             filt_np = np.asarray(
-                self._eval_all(idx, call.children[0], shard_list)
+                self._eval_all(idx, call.children[0], shard_list, mask)
             ).reshape(len(shard_list), WORDS_PER_SHARD)
         vals: set = set()
         for si, shard in enumerate(shard_list):
+            if mask is not None and shard not in mask.subset:
+                continue  # host loop skips non-subset shards outright
             frag = field.bsi_fragment(shard)
             if frag is None:
                 continue
@@ -813,7 +983,8 @@ class Executor:
 
     # -- GroupBy (reference: executor.go:3918 executeGroupByShard) -------------
 
-    def _execute_groupby(self, idx: Index, call: Call, shards) -> Any:
+    def _execute_groupby(self, idx: Index, call: Call, shards,
+                         mask: Optional[ShardMask] = None) -> Any:
         if not call.children:
             raise PQLError("GroupBy requires at least one Rows child")
         rows_calls = [c for c in call.children if c.name == "Rows"]
@@ -838,8 +1009,13 @@ class Executor:
         sts = [stacked_set(f, shard_list, timeq.VIEW_STANDARD) for f in fields]
         if any(not st.row_ids for st in sts):
             return []
-        filt = (self._eval_all(idx, filter_call, shard_list)
+        filt = (self._eval_all(idx, filter_call, shard_list, mask)
                 if filter_call is not None else None)
+        if mask is not None:
+            # mask folds into the group filter: level-0 planes get ANDed
+            # with it, the AND-fold keeps it, and _groupby_emit drops the
+            # count==0 groups — identical output to a solo subset run
+            filt = S.mask_filter(filt, mask.plane)
         agg_st = stacked_bsi(agg_field, shard_list) if agg_field is not None else None
 
         if len(sts) <= 2 and self._groupby_dense_ok(sts, agg_st):
@@ -1032,7 +1208,8 @@ class Executor:
 
     # -- Percentile (reference: executor.go:1310) ------------------------------
 
-    def _execute_percentile(self, idx: Index, call: Call, shards) -> Any:
+    def _execute_percentile(self, idx: Index, call: Call, shards,
+                            mask: Optional[ShardMask] = None) -> Any:
         fname = call.arg("field") or call.arg("_field")
         field = idx.field(fname)
         nth = call.arg("nth")
@@ -1046,8 +1223,10 @@ class Executor:
         if not shard_list:
             return R.ValCount(val=None, count=0)
         st = stacked_bsi(field, shard_list)
-        filt = (self._eval_all(idx, filter_call, shard_list)
+        filt = (self._eval_all(idx, filter_call, shard_list, mask)
                 if filter_call is not None else st.exists_plane())
+        if mask is not None:
+            filt = S.mask_filter(filt, mask.plane)
         bits, negative, cnt, total = S._kth_kernel(
             st.planes, filt, jnp.int32(round(nth * 100)))
 

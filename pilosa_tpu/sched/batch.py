@@ -22,7 +22,7 @@ import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
 from pilosa_tpu.cache.keys import shard_key
-from pilosa_tpu.pql.ast import Call, Query
+from pilosa_tpu.pql.ast import Call, Query, unwrap_options
 
 # Top-level call name -> op family. Families batch together; anything
 # unlisted (Extract/Apply/Arrow/Sort/... — wide, host-heavy results)
@@ -37,6 +37,17 @@ _FAMILY = {
     "Sum": "agg", "Min": "agg", "Max": "agg", "Percentile": "agg",
     "TopN": "rank", "TopK": "rank", "Rows": "rank", "GroupBy": "rank",
 }
+
+# Families eligible for cross-shard-set (superset) fusion: their results
+# stay exact under the executor's per-query shard mask. "scan" families
+# walk fragments host-side and never merge across shard sets.
+FUSIBLE_FAMILIES = frozenset({"count", "bitmap", "agg", "rank"})
+
+
+def fusible_family(family: str) -> bool:
+    """True when every part of a (possibly composite "a+b") family is
+    superset-fusible."""
+    return all(part in FUSIBLE_FAMILIES for part in family.split("+"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,10 +67,9 @@ def family_of(query: Query) -> str:
     query gets a composite key so identical mixes still batch."""
     fams = []
     for call in query.calls:
-        inner = call
-        while inner.name == "Options" and inner.children:
-            inner = inner.children[0]
-        f = _FAMILY.get(inner.name, "scan")
+        # shared unwrap (pql/ast.py) — keeps this classification in
+        # lockstep with the executor's maskability check
+        f = _FAMILY.get(unwrap_options(call).name, "scan")
         if f not in fams:
             fams.append(f)
     return "+".join(sorted(fams)) or "scan"
@@ -94,8 +104,23 @@ def execute_batch(executor, entries: List) -> None:
         _run_single(executor, first)
         return
     many = getattr(executor, "execute_many", None)
+    canon = shard_key(first.shards)
+    hetero = any(shard_key(e.shards) != canon for e in entries)
+    if hetero and (many is None
+                   or not getattr(executor, "supports_shard_masks", False)):
+        # superset-merged batch against an executor that cannot mask —
+        # should not happen (the scheduler gates merging on this same
+        # probe), but degrade to solo runs rather than corrupt results
+        for e in entries:
+            _run_single(executor, e)
+        return
     try:
-        if many is not None:
+        if hetero:
+            # cross-shard-set fusion: one dispatch over the union
+            # layout, each query masked to its own subset
+            per_query = many(first.index, [e.query for e in entries],
+                             per_query_shards=[e.shards for e in entries])
+        elif many is not None:
             # native fusion primitive (pql/executor.py execute_many):
             # per-query call lists stay intact, one blocking sync
             per_query = many(first.index, [e.query for e in entries],

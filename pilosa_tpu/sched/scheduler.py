@@ -21,9 +21,10 @@ from typing import List, Optional, Sequence, Union
 from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
 from pilosa_tpu.obs import metrics as obs_metrics
 from pilosa_tpu.pql.ast import Call, Query
-from pilosa_tpu.pql.executor import has_write_calls
+from pilosa_tpu.pql.executor import has_write_calls, query_maskable
 from pilosa_tpu.pql.parser import parse
-from pilosa_tpu.sched.batch import GroupKey, execute_batch, group_key
+from pilosa_tpu.sched.batch import (GroupKey, execute_batch, fusible_family,
+                                    group_key)
 from pilosa_tpu.sched.clock import MonotonicClock
 
 PRIORITY_INTERACTIVE = "interactive"
@@ -33,7 +34,7 @@ _PRIORITY_RANK = {PRIORITY_INTERACTIVE: 0, PRIORITY_BATCH: 1}
 
 class _Pending:
     __slots__ = ("index", "query", "shards", "priority", "rank", "deadline",
-                 "future", "enqueued", "seq", "key")
+                 "future", "enqueued", "seq", "key", "fusible")
 
     def __init__(self, index: str, query: Query,
                  shards: Optional[Sequence[int]], priority: str,
@@ -48,6 +49,11 @@ class _Pending:
         self.enqueued = enqueued
         self.seq = seq
         self.key: GroupKey = group_key(index, query, shards)
+        # eligible for cross-shard-set (superset) fusion: explicit shard
+        # set + a family AND a call tree the executor can mask exactly
+        self.fusible = (self.key.shards is not None
+                        and fusible_family(self.key.family)
+                        and query_maskable(query))
 
 
 class _Resolved:
@@ -88,17 +94,46 @@ class QueryScheduler:
     pending query at most this long so concurrent arrivals can join its
     dispatch. 0 disables coalescing-by-time (still batches whatever is
     queued at take time). ``default_deadline_ms`` ≤ 0 means no deadline.
+
+    ``fuse_waste_ratio`` > 0 enables cross-shard-set fusion: after the
+    exact-key take, queued fusible queries in the same (index, family)
+    merge into the batch over the union of their shard sets, each masked
+    to its own subset by the executor, as long as the union stays within
+    ``fuse_waste_ratio`` x the largest member set. 0 disables merging.
+
+    ``adaptive_window=True`` replaces the fixed window with one sized
+    from the EWMA of arrival gaps, clamped to [window_min_ms,
+    window_max_ms]: near-idle traffic dispatches almost immediately
+    (solo queries don't idle out the full horizon), bursty traffic earns
+    the full window so batches fill.
     """
+
+    # EWMA smoothing for arrival gaps; ~universal "last ≈ 5 samples"
+    _EWMA_ALPHA = 0.2
 
     def __init__(self, executor, *, window_ms: float = 0.5,
                  max_batch: int = 64, max_queue: int = 1024,
-                 default_deadline_ms: float = 0.0, clock=None,
-                 registry=None):
+                 default_deadline_ms: float = 0.0,
+                 fuse_waste_ratio: float = 2.0,
+                 adaptive_window: bool = False,
+                 window_min_ms: float = 0.2, window_max_ms: float = 5.0,
+                 clock=None, registry=None):
         self.executor = executor
         self.window_s = max(0.0, float(window_ms)) / 1000.0
         self.max_batch = max(1, int(max_batch))
         self.max_queue = max(1, int(max_queue))
         self.default_deadline_s = max(0.0, float(default_deadline_ms)) / 1e3
+        self.fuse_waste_ratio = max(0.0, float(fuse_waste_ratio))
+        # superset merges need the executor's masked execute_many
+        self._fusion_ok = (
+            self.fuse_waste_ratio > 0
+            and getattr(executor, "supports_shard_masks", False)
+            and callable(getattr(executor, "execute_many", None)))
+        self.adaptive_window = bool(adaptive_window)
+        self.window_min_s = max(0.0, float(window_min_ms)) / 1e3
+        self.window_max_s = max(self.window_min_s, float(window_max_ms) / 1e3)
+        self._gap_ewma: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         self.clock = clock if clock is not None else MonotonicClock()
         self.registry = registry if registry is not None else (
             obs_metrics.REGISTRY)
@@ -121,6 +156,10 @@ class QueryScheduler:
             max_batch=config.scheduler_max_batch,
             max_queue=config.scheduler_max_queue,
             default_deadline_ms=config.scheduler_default_deadline_ms,
+            fuse_waste_ratio=config.scheduler_fuse_waste_ratio,
+            adaptive_window=config.scheduler_adaptive_window,
+            window_min_ms=config.scheduler_window_min_ms,
+            window_max_ms=config.scheduler_window_max_ms,
         )
         kw.update(overrides)
         return cls(executor, **kw)
@@ -163,6 +202,8 @@ class QueryScheduler:
                 raise AdmissionError(
                     f"admission queue full ({len(self._queue)} queued, "
                     f"limit {limit} for priority={priority})")
+            if self.adaptive_window:
+                self._observe_arrival(now)
             pending = _Pending(
                 index, query, shards, priority,
                 now + deadline_s if deadline_s > 0 else None, now, self._seq)
@@ -252,6 +293,41 @@ class QueryScheduler:
     def as_executor(self) -> "SchedulingExecutor":
         return SchedulingExecutor(self)
 
+    # -- adaptive window ---------------------------------------------------
+
+    def _observe_arrival(self, now: float) -> None:
+        """EWMA of inter-arrival gaps (locked; called from submit)."""
+        last = self._last_arrival
+        self._last_arrival = now
+        if last is None:
+            return
+        gap = max(now - last, 1e-6)
+        if self._gap_ewma is None:
+            self._gap_ewma = gap
+        else:
+            self._gap_ewma += self._EWMA_ALPHA * (gap - self._gap_ewma)
+
+    def _window_s(self) -> float:
+        """Effective batching window. Adaptive sizing scales with the
+        observed arrival rate: the window earns its full length exactly
+        when a max_batch-sized cohort is expected to arrive within
+        window_max (gap <= window_max / max_batch); an idle stream
+        collapses to window_min so solo queries dispatch promptly."""
+        if not self.adaptive_window:
+            return self.window_s
+        gap = self._gap_ewma
+        if gap is None:
+            w = self.window_min_s
+        else:
+            w = self.window_max_s ** 2 / (gap * self.max_batch)
+            w = min(max(w, self.window_min_s), self.window_max_s)
+        self.registry.gauge(obs_metrics.METRIC_SCHED_WINDOW_MS, w * 1e3)
+        return w
+
+    def current_window_ms(self) -> float:
+        with self._lock:
+            return self._window_s() * 1e3
+
     # -- worker ------------------------------------------------------------
 
     def _loop(self) -> None:
@@ -280,12 +356,31 @@ class QueryScheduler:
             head = min(self._queue, key=lambda p: (p.rank, p.seq))
             now = self.clock.now()
             same = sum(1 for p in self._queue if p.key == head.key)
+            window_s = self._window_s()
             ripe = (same >= self.max_batch
-                    or now >= head.enqueued + self.window_s)
+                    or now >= head.enqueued + window_s)
             if not ripe:
-                self.clock.wait(self._cv, head.enqueued + self.window_s - now)
+                self.clock.wait(self._cv, head.enqueued + window_s - now)
                 continue
             return self._take_locked(head.key, now)
+
+    def _claim_locked(self, p: _Pending, now: float,
+                      batch: List[_Pending]) -> None:
+        """Move one queued entry into ``batch`` (or fail it), honoring
+        cancellation and deadlines — shared by the exact-key take and
+        the superset merge so claimed entries behave identically."""
+        if not p.future.set_running_or_notify_cancel():
+            return  # caller cancelled while queued
+        if p.deadline is not None and now > p.deadline:
+            self.registry.count(obs_metrics.METRIC_SCHED_DEADLINE_MISS,
+                              priority=p.priority)
+            p.future.set_exception(QueryDeadlineError(
+                f"deadline exceeded after "
+                f"{(now - p.enqueued) * 1e3:.1f} ms in queue"))
+            return
+        self.registry.observe(obs_metrics.METRIC_SCHED_BATCH_WAIT,
+                              now - p.enqueued)
+        batch.append(p)
 
     def _take_locked(self, key: GroupKey, now: float) -> List[_Pending]:
         batch: List[_Pending] = []
@@ -294,21 +389,62 @@ class QueryScheduler:
             if p.key != key or len(batch) >= self.max_batch:
                 keep.append(p)
                 continue
-            if not p.future.set_running_or_notify_cancel():
-                continue  # caller cancelled while queued
-            if p.deadline is not None and now > p.deadline:
-                self.registry.count(obs_metrics.METRIC_SCHED_DEADLINE_MISS,
-                                  priority=p.priority)
-                p.future.set_exception(QueryDeadlineError(
-                    f"deadline exceeded after "
-                    f"{(now - p.enqueued) * 1e3:.1f} ms in queue"))
-                continue
-            self.registry.observe(obs_metrics.METRIC_SCHED_BATCH_WAIT,
-                                  now - p.enqueued)
-            batch.append(p)
+            self._claim_locked(p, now, batch)
+        if (self._fusion_ok and batch and key.shards is not None
+                and len(batch) < self.max_batch
+                and all(p.fusible for p in batch)):
+            keep = self._merge_superset_locked(key, batch, keep, now)
         self._queue = keep
         self.registry.gauge(obs_metrics.METRIC_SCHED_QUEUE_DEPTH, len(keep))
         return batch
+
+    def _merge_superset_locked(self, key: GroupKey, batch: List[_Pending],
+                               keep: List[_Pending], now: float
+                               ) -> List[_Pending]:
+        """Cross-shard-set fusion: grow the just-taken batch with queued
+        fusible queries of the same (index, family) whose shard sets
+        merge within the padding budget — the running union may exceed
+        the largest member set by at most ``fuse_waste_ratio`` x.
+        Admitted entries leave the queue and are claimed exactly like
+        exact-key takes; everything else stays queued untouched."""
+        union = set(key.shards)
+        max_sub = max(len(p.key.shards) for p in batch)
+        candidates = sorted(
+            (p for p in keep
+             if (p.fusible and p.key.index == key.index
+                 and p.key.family == key.family)),
+            key=lambda p: (p.rank, p.seq))
+        admitted: List[_Pending] = []
+        merged_keys = set()
+        for p in candidates:
+            if len(batch) + len(admitted) >= self.max_batch:
+                break
+            cand = set(p.key.shards)
+            new_union = union | cand
+            biggest = max(max_sub, len(cand))
+            if len(new_union) > self.fuse_waste_ratio * biggest:
+                continue  # too much padding; stays queued for later
+            union = new_union
+            max_sub = biggest
+            admitted.append(p)
+            merged_keys.add(p.key.shards)
+        if not admitted:
+            return keep
+        admitted_ids = set(map(id, admitted))
+        keep = [p for p in keep if id(p) not in admitted_ids]
+        before = len(batch)
+        for p in admitted:
+            self._claim_locked(p, now, batch)
+        if len(batch) > before:
+            self.registry.count(obs_metrics.METRIC_SCHED_SUPERSET_MERGES,
+                              len(merged_keys), family=key.family)
+            self.registry.count(obs_metrics.METRIC_SCHED_FUSED_QUERIES,
+                              len(batch), family=key.family)
+            self.registry.observe_bucketed(
+                obs_metrics.METRIC_SCHED_PADDING_WASTE,
+                len(union) / max(1, max_sub),
+                obs_metrics.PADDING_WASTE_BUCKETS, family=key.family)
+        return keep
 
     def _dispatch(self, batch: List[_Pending]) -> None:
         family = batch[0].key.family

@@ -1,0 +1,234 @@
+"""Cross-shard-set fusion: masked superset execution must be
+bit-identical to unfused per-subset execution.
+
+Property under test (pql/executor.py ShardMask): for ANY read query of a
+fusible family and ANY shard subset, executing it masked over the union
+stacked layout returns byte-for-byte the result of executing it solo
+over just its own shards — including single-shard subsets, subsets with
+empty pairwise intersection, and data that never intersects the mask.
+Everything runs deterministically under JAX_PLATFORMS=cpu.
+"""
+
+import random
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.obs.metrics import MetricsRegistry
+from pilosa_tpu.pql.result import result_to_json
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def fusion_api():
+    """8 shards of set + BSI data (negatives included) so every family
+    has non-trivial per-shard answers: city rows differ per column,
+    amt values span sign and magnitude."""
+    api = API()
+    api.create_index("fz")
+    api.create_field("fz", "city")
+    api.create_field("fz", "device")
+    api.create_field("fz", "amt", {"type": "int", "min": -100, "max": 200})
+    rng = random.Random(1234)
+    cols, cities, dcols, devices, vcols, vals = [], [], [], [], [], []
+    for shard in range(N_SHARDS):
+        base = shard * SHARD_WIDTH
+        for i in rng.sample(range(600), 80):
+            cols.append(base + i)
+            cities.append((i + shard) % 5)
+            dcols.append(base + i)
+            devices.append(i % 3)
+            vcols.append(base + i)
+            vals.append(rng.randrange(-60, 120))
+    api.import_bits("fz", "city", rows=cities, cols=cols)
+    api.import_bits("fz", "device", rows=devices, cols=dcols)
+    api.import_values("fz", "amt", cols=vcols, values=vals)
+    return api
+
+
+# One representative query per family branch the mask threads through:
+# count / bitmap (incl. Not+existence, Shift, UnionRows limit) / agg
+# (Sum, Min/Max, Percentile) / rank (TopN, Rows, GroupBy) / Distinct.
+FAMILY_QUERIES = [
+    "Count(Row(city=1))",
+    "Count(Intersect(Row(city=0), Row(device=1)))",
+    "Count(Row(amt > 10))",
+    "Row(city=2)",
+    "Union(Row(city=0), Row(city=3))",
+    "Difference(Row(city=1), Row(device=0))",
+    "Xor(Row(city=1), Row(city=2))",
+    "Not(Row(city=1))",
+    "Shift(Row(city=4), n=2)",
+    "UnionRows(Rows(city, limit=3))",
+    "Limit(Row(city=0), limit=7, offset=2)",
+    "Sum(Row(city=1), field=amt)",
+    "Sum(field=amt)",
+    "Min(field=amt)",
+    "Max(Row(device=2), field=amt)",
+    "Percentile(field=amt, nth=50)",
+    "TopN(city, n=3)",
+    "TopK(device, k=2)",
+    "Rows(city)",
+    "Rows(city, limit=2)",
+    "GroupBy(Rows(city))",
+    "GroupBy(Rows(city), Rows(device), aggregate=Sum(field=amt))",
+    "Distinct(field=city)",
+    "Count(Distinct(field=amt))",
+]
+
+# Subset shapes: single shard, half sets with empty pairwise
+# intersection, interleaved, full set, and edges-only.
+SUBSETS = [
+    [0, 1, 2, 3],
+    [4, 5, 6, 7],  # empty intersection with the previous
+    [2],           # single shard
+    [1, 3, 5, 7],
+    list(range(N_SHARDS)),
+    [0, 7],
+]
+
+
+def _solo(api, query, shards):
+    return [result_to_json(r)
+            for r in api.executor.execute("fz", query, shards=shards)]
+
+
+class TestMaskedSupersetParity:
+    @pytest.mark.parametrize("query", FAMILY_QUERIES)
+    def test_each_family_bit_identical_across_subsets(self, fusion_api,
+                                                      query):
+        api = fusion_api
+        queries = [query] * len(SUBSETS)
+        fused = api.executor.execute_many("fz", queries,
+                                          per_query_shards=SUBSETS)
+        for shards, got in zip(SUBSETS, fused):
+            want = _solo(api, query, shards)
+            assert [result_to_json(r) for r in got] == want, shards
+
+    def test_mixed_families_one_fused_round(self, fusion_api):
+        """One execute_many over heterogeneous queries AND subsets —
+        the realistic merged-batch shape."""
+        api = fusion_api
+        rng = random.Random(99)
+        queries, subsets = [], []
+        for _ in range(24):
+            queries.append(rng.choice(FAMILY_QUERIES))
+            subsets.append(sorted(rng.sample(range(N_SHARDS), 4)))
+        fused = api.executor.execute_many("fz", queries,
+                                          per_query_shards=subsets)
+        for q, s, got in zip(queries, subsets, fused):
+            assert [result_to_json(r) for r in got] == _solo(api, q, s)
+
+    def test_empty_subset_matches_solo(self, fusion_api):
+        api = fusion_api
+        fused = api.executor.execute_many(
+            "fz", ["Count(Row(city=1))", "Count(Row(city=1))"],
+            per_query_shards=[[], [0, 1]])
+        assert fused[0] == _solo(api, "Count(Row(city=1))", [])
+        assert fused[1] == _solo(api, "Count(Row(city=1))", [0, 1])
+
+    def test_unmaskable_query_keeps_own_shards(self, fusion_api):
+        """A scan-family query in a fused round runs over its own shard
+        list (no mask) and still returns exact results."""
+        api = fusion_api
+        q_scan = "Extract(Row(city=1), Rows(device))"
+        q_count = "Count(Row(city=1))"
+        fused = api.executor.execute_many(
+            "fz", [q_scan, q_count], per_query_shards=[[2, 3], [0, 1]])
+        assert [result_to_json(r) for r in fused[0]] == _solo(
+            api, q_scan, [2, 3])
+        assert fused[1] == _solo(api, q_count, [0, 1])
+
+    def test_per_query_shards_length_mismatch_rejected(self, fusion_api):
+        with pytest.raises(ValueError):
+            fusion_api.executor.execute_many(
+                "fz", ["Count(Row(city=1))"], per_query_shards=[[0], [1]])
+
+
+class TestFusedCacheFill:
+    def test_superset_run_fills_exact_per_query_entries(self, fusion_api):
+        """A masked superset dispatch must warm the cache under each
+        query's OWN shard set: a later solo read of the same (query,
+        subset) is a hit, and a read over a different subset is not."""
+        api = fusion_api
+        api.enable_cache()
+        try:
+            cache = api.cache
+            q = "Count(Row(city=3))"
+            fused = api.executor.execute_many(
+                "fz", [q, q], per_query_shards=[[0, 1], [4, 5]])
+            h0 = cache.stats()["hits"]
+            again = api.executor.execute("fz", q, shards=[0, 1])
+            assert cache.stats()["hits"] == h0 + 1
+            assert again == fused[0]
+            # different subset: its own entry, filled by the same round
+            assert api.executor.execute("fz", q, shards=[4, 5]) == fused[1]
+            assert cache.stats()["hits"] == h0 + 2
+            # union itself was never executed as a query -> miss
+            hits_before = cache.stats()["hits"]
+            api.executor.execute("fz", q, shards=[0, 1, 4, 5])
+            assert cache.stats()["hits"] == hits_before
+        finally:
+            api.disable_cache()
+
+    def test_cached_superset_round_is_one_dispatch(self, fusion_api):
+        api = fusion_api
+        api.enable_cache()
+        try:
+            reg = MetricsRegistry()
+            sched = api.enable_scheduler(window_ms=0, max_batch=64,
+                                         fuse_waste_ratio=8.0, registry=reg)
+            sched.pause()
+            handles = [
+                sched.submit("fz", f"Count(Row(city={k}))", shards=s)
+                for k, s in enumerate(([0, 1], [1, 2], [2, 3], [3, 4]))]
+            assert sched.wait_queued(4) == 4
+            sched.resume()
+            got = [h.result(timeout=10)[0] for h in handles]
+            want = [api.executor.execute(
+                "fz", f"Count(Row(city={k}))", shards=s)[0]
+                for k, s in enumerate(([0, 1], [1, 2], [2, 3], [3, 4]))]
+            assert got == want
+            counters = reg.as_json()["counters"]
+            batches = sum(v for k, v in counters.items()
+                          if k.startswith("sched_batches_total"))
+            assert batches == 1
+            merges = sum(v for k, v in counters.items()
+                         if k.startswith("sched_superset_merges_total"))
+            assert merges == 3
+        finally:
+            api.disable_scheduler()
+            api.disable_cache()
+
+
+class TestFusionMetricsExposition:
+    def test_padding_waste_histogram_and_names(self, fusion_api):
+        from pilosa_tpu.obs import metrics as M
+
+        api = fusion_api
+        reg = MetricsRegistry()
+        sched = api.enable_scheduler(window_ms=0, max_batch=64,
+                                     fuse_waste_ratio=8.0, registry=reg)
+        try:
+            sched.pause()
+            hs = [sched.submit("fz", "Count(Row(city=1))", shards=[0, 1]),
+                  sched.submit("fz", "Count(Row(city=2))", shards=[2, 3])]
+            assert sched.wait_queued(2) == 2
+            sched.resume()
+            for h in hs:
+                h.result(timeout=10)
+            text = reg.prometheus_text()
+            assert "sched_superset_merges_total" in text
+            assert "sched_fused_queries_total" in text
+            assert "sched_padding_waste_ratio" in text
+            j = reg.as_json()
+            assert reg.value(M.METRIC_SCHED_SUPERSET_MERGES,
+                             family="count") == 1
+            # union of {0,1} and {2,3} is 4 shards over max subset 2 -> 2.0
+            waste = [k for k in j["histograms"]
+                     if k.startswith(M.METRIC_SCHED_PADDING_WASTE)]
+            assert waste
+        finally:
+            api.disable_scheduler()

@@ -426,3 +426,269 @@ class TestConfigSurface:
         api.disable_scheduler()
         assert api.read_executor() is api.executor
         assert api.scheduler is None
+
+
+class StubFusionExecutor(StubExecutor):
+    """StubExecutor advertising masked superset execution. Records the
+    per_query_shards each fused dispatch received, so merge decisions
+    (who joined, in what order) are directly observable."""
+
+    supports_shard_masks = True
+
+    def execute_many(self, index, queries, shards=None,
+                     per_query_shards=None):
+        with self._lock:
+            self.calls.append((
+                index, [[c.name for c in q.calls] for q in queries],
+                shards if per_query_shards is None
+                else list(per_query_shards)))
+        if any(self.fail_when(q) for q in queries):
+            raise RuntimeError("stub failure")
+        return [[c.to_pql() for c in q.calls] for q in queries]
+
+
+class TestSupersetFusion:
+    def test_overlapping_shard_sets_merge_into_one_dispatch(self, make_sched):
+        stub = StubFusionExecutor()
+        reg = MetricsRegistry()
+        s = make_sched(stub, window_ms=0, max_batch=64,
+                       fuse_waste_ratio=2.0, registry=reg)
+        s.pause()
+        handles = [
+            s.submit("i", "Count(Row(f=1))", shards=[0, 1, 2, 3]),
+            s.submit("i", "Count(Row(f=2))", shards=[2, 3, 4, 5]),
+            s.submit("i", "Count(Row(f=3))", shards=[4, 5, 6, 7]),
+        ]
+        assert s.wait_queued(3) == 3
+        s.resume()
+        results = [h.result(timeout=5) for h in handles]
+        assert results == [[f"Count(Row(f={k}))"] for k in (1, 2, 3)]
+        assert len(stub.calls) == 1  # ONE fused dispatch across 3 sets
+        _, _, per_q = stub.calls[0]
+        assert per_q == [(0, 1, 2, 3), (2, 3, 4, 5), (4, 5, 6, 7)]
+        assert reg.value(M.METRIC_SCHED_SUPERSET_MERGES, family="count") == 2
+        assert reg.value(M.METRIC_SCHED_FUSED_QUERIES, family="count") == 3
+        assert reg.value(M.METRIC_SCHED_BATCHES, family="count") == 1
+
+    def test_waste_ratio_gates_merging(self, make_sched):
+        stub = StubFusionExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64, fuse_waste_ratio=1.5)
+        s.pause()
+        a = s.submit("i", "Count(Row(f=1))", shards=[0, 1])
+        b = s.submit("i", "Count(Row(f=2))", shards=[2, 3])  # union 4 > 1.5*2
+        assert s.wait_queued(2) == 2
+        s.resume()
+        a.result(timeout=5), b.result(timeout=5)
+        assert len(stub.calls) == 2  # padding budget refused the merge
+
+    def test_zero_ratio_disables_fusion(self, make_sched):
+        stub = StubFusionExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64, fuse_waste_ratio=0)
+        s.pause()
+        a = s.submit("i", "Count(Row(f=1))", shards=[0, 1])
+        b = s.submit("i", "Count(Row(f=2))", shards=[0, 1, 2])
+        assert s.wait_queued(2) == 2
+        s.resume()
+        a.result(timeout=5), b.result(timeout=5)
+        assert len(stub.calls) == 2
+
+    def test_executor_without_masks_never_merges(self, make_sched):
+        stub = StubExecutor()  # no supports_shard_masks / execute_many
+        s = make_sched(stub, window_ms=0, max_batch=64, fuse_waste_ratio=8.0)
+        s.pause()
+        a = s.submit("i", "Count(Row(f=1))", shards=[0, 1])
+        b = s.submit("i", "Count(Row(f=2))", shards=[1, 2])
+        assert s.wait_queued(2) == 2
+        s.resume()
+        a.result(timeout=5), b.result(timeout=5)
+        assert len(stub.calls) == 2
+
+    def test_scan_family_and_none_shards_excluded(self, make_sched):
+        stub = StubFusionExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64, fuse_waste_ratio=8.0)
+        s.pause()
+        a = s.submit("i", "Extract(All(), Rows(f))", shards=[0, 1])
+        b = s.submit("i", "Extract(All(), Rows(f))", shards=[1, 2])
+        c = s.submit("i", "Count(Row(f=1))")  # None = all-shards group
+        d = s.submit("i", "Count(Row(f=2))", shards=[0, 1])
+        assert s.wait_queued(4) == 4
+        s.resume()
+        for h in (a, b, c, d):
+            h.result(timeout=5)
+        # scan queries and the None-shards group each dispatch apart
+        assert len(stub.calls) == 4
+
+    def test_options_shards_override_not_fused(self, make_sched):
+        stub = StubFusionExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=64, fuse_waste_ratio=8.0)
+        s.pause()
+        a = s.submit("i", "Count(Row(f=1))", shards=[0, 1])
+        b = s.submit("i", "Options(Count(Row(f=2)), shards=[9])",
+                     shards=[1, 2])
+        assert s.wait_queued(2) == 2
+        s.resume()
+        a.result(timeout=5), b.result(timeout=5)
+        # the per-call override re-scopes the read; it must keep its own
+        # dispatch rather than execute under a union-sized mask
+        assert len(stub.calls) == 2
+
+    def test_merge_respects_max_batch(self, make_sched):
+        stub = StubFusionExecutor()
+        s = make_sched(stub, window_ms=0, max_batch=2, fuse_waste_ratio=8.0)
+        s.pause()
+        handles = [s.submit("i", f"Count(Row(f={k}))", shards=[k, k + 1])
+                   for k in range(3)]
+        assert s.wait_queued(3) == 3
+        s.resume()
+        for h in handles:
+            h.result(timeout=5)
+        assert sorted(len(qs) for _, qs, _ in stub.calls) == [1, 2]
+
+    def test_merged_candidate_cancel_and_deadline_honored(self, make_sched):
+        stub = StubFusionExecutor()
+        clock = ManualClock()
+        s = make_sched(stub, window_ms=0, max_batch=64,
+                       fuse_waste_ratio=8.0, clock=clock)
+        s.pause()
+        lead = s.submit("i", "Count(Row(f=1))", shards=[0, 1])
+        doomed = s.submit("i", "Count(Row(f=2))", shards=[1, 2],
+                          deadline_ms=10)
+        gone = s.submit("i", "Count(Row(f=3))", shards=[2, 3])
+        ok = s.submit("i", "Count(Row(f=4))", shards=[3, 4])
+        assert s.wait_queued(4) == 4
+        assert gone.cancel()
+        clock.advance(0.05)  # past doomed's deadline
+        s.resume()
+        assert lead.result(timeout=5) == ["Count(Row(f=1))"]
+        assert ok.result(timeout=5) == ["Count(Row(f=4))"]
+        with pytest.raises(QueryDeadlineError):
+            doomed.result(timeout=5)
+        with pytest.raises(QueryDeadlineError):
+            gone.result(timeout=5)
+        # one dispatch; only the live entries reached the executor
+        assert len(stub.calls) == 1
+        assert stub.calls[0][2] == [(0, 1), (3, 4)]
+
+    def test_fused_results_bit_identical_to_sequential(self, parity_api):
+        api = parity_api
+        shards = [0]  # the 300-col fixture lives entirely in shard 0
+        queries = _mixed_queries()
+        want = [result_to_json(api.query("p", q, shards=shards)[0])
+                for q in queries]
+        reg = MetricsRegistry()
+        sched = api.enable_scheduler(window_ms=0, max_batch=64,
+                                     fuse_waste_ratio=8.0, registry=reg)
+        try:
+            sched.pause()
+            handles = [sched.submit("p", q, shards=shards) for q in queries]
+            assert sched.wait_queued(len(queries)) == len(queries)
+            sched.resume()
+            got = [result_to_json(h.result(timeout=10)[0]) for h in handles]
+        finally:
+            api.disable_scheduler()
+        assert got == want
+
+
+class TestAdaptiveWindow:
+    def test_disabled_by_default(self, make_sched):
+        s = make_sched(StubExecutor(), window_ms=3)
+        assert s.adaptive_window is False
+        assert s.current_window_ms() == 3.0
+
+    def test_idle_stream_collapses_to_min(self, make_sched):
+        clock = ManualClock()
+        s = make_sched(StubExecutor(), adaptive_window=True,
+                       window_min_ms=1, window_max_ms=100, max_batch=10,
+                       clock=clock)
+        s.pause()
+        # arrivals 10s apart: no batch will ever fill; don't hold anyone
+        for k in range(4):
+            s.submit("i", f"Count(Row(f={k}))")
+            clock.advance(10.0)
+        assert s.current_window_ms() == 1.0
+        s.resume()
+
+    def test_burst_earns_full_window(self, make_sched):
+        clock = ManualClock()
+        s = make_sched(StubExecutor(), adaptive_window=True,
+                       window_min_ms=1, window_max_ms=100, max_batch=10,
+                       clock=clock)
+        s.pause()
+        # 1ms gaps: a 10-query batch fills well inside window_max
+        for k in range(8):
+            s.submit("i", f"Count(Row(f={k}))")
+            clock.advance(0.001)
+        assert s.current_window_ms() == 100.0
+        s.resume()
+
+    def test_window_tracks_load_shift(self, make_sched):
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        s = make_sched(StubExecutor(), adaptive_window=True,
+                       window_min_ms=1, window_max_ms=100, max_batch=10,
+                       clock=clock, registry=reg)
+        s.pause()
+        for k in range(8):
+            s.submit("i", f"Count(Row(f={k}))")
+            clock.advance(0.001)
+        busy = s.current_window_ms()
+        for k in range(20):
+            s.submit("i", f"Count(Row(g={k}))")
+            clock.advance(5.0)
+        idle = s.current_window_ms()
+        assert busy > idle
+        assert reg.value(M.METRIC_SCHED_WINDOW_MS) == idle
+        s.resume()
+
+    def test_from_config_carries_adaptive_fields(self):
+        from pilosa_tpu.config import Config
+
+        cfg = Config.from_sources(env={
+            "PILOSA_TPU_SCHEDULER_FUSE_WASTE_RATIO": "3.5",
+            "PILOSA_TPU_SCHEDULER_ADAPTIVE_WINDOW": "true",
+            "PILOSA_TPU_SCHEDULER_WINDOW_MIN_MS": "0.5",
+            "PILOSA_TPU_SCHEDULER_WINDOW_MAX_MS": "9",
+        })
+        assert cfg.scheduler_fuse_waste_ratio == 3.5
+        assert cfg.scheduler_adaptive_window is True
+        assert cfg.scheduler_window_min_ms == 0.5
+        assert cfg.scheduler_window_max_ms == 9.0
+        s = QueryScheduler.from_config(StubFusionExecutor(), cfg,
+                                       registry=MetricsRegistry())
+        try:
+            assert s.fuse_waste_ratio == 3.5
+            assert s.adaptive_window is True
+            assert s.window_min_s == 0.0005
+            assert s.window_max_s == 0.009
+        finally:
+            s.close()
+
+
+class TestFamilyClassification:
+    """family_of / fusibility must agree with the executor's maskability
+    (regression: Options unwrapping is now shared via pql/ast.py)."""
+
+    def test_family_unwraps_nested_options(self):
+        from pilosa_tpu.pql.ast import Call, Query
+
+        inner = parse("Count(Row(f=1))").calls[0]
+        wrapped = Query([Call("Options", {"shards": [0]}, [
+            Call("Options", {}, [inner])])])
+        assert family_of(wrapped) == "count"
+
+    def test_fusible_families(self):
+        from pilosa_tpu.sched.batch import fusible_family
+
+        assert fusible_family("count")
+        assert fusible_family("agg+bitmap")
+        assert not fusible_family("scan")
+        assert not fusible_family("count+scan")
+
+    def test_options_shards_blocks_maskability_not_family(self):
+        from pilosa_tpu.pql.executor import query_maskable
+
+        plain = parse("Options(Count(Row(f=1)), exclude=true)")
+        scoped = parse("Options(Count(Row(f=1)), shards=[0])")
+        assert family_of(plain) == family_of(scoped) == "count"
+        assert query_maskable(plain)
+        assert not query_maskable(scoped)
