@@ -568,6 +568,104 @@ def bench_config8(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 9 — fan-out under an injected straggler: hedged vs unhedged
+# ---------------------------------------------------------------------------
+
+def bench_config9(device: str) -> None:
+    """3-node in-process cluster (replica_n=2) with a FaultPlan delaying
+    every RPC to one non-coordinator node by ~10x the healthy leg
+    latency. Unhedged fan-out pays the full delay on every query (its
+    p99 IS the injected straggle); with resilience attached the slow leg
+    hedges onto the replica after the rolling per-node percentile and
+    the hedge wave wins. Every read in every phase is asserted
+    bit-identical to the no-fault result."""
+    from pilosa_tpu.cluster import FaultPlan, LocalCluster
+    from pilosa_tpu.obs.metrics import MetricsRegistry
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(9)
+    plan = FaultPlan(seed=9)
+    c = LocalCluster(3, replica_n=2, fault_plan=plan)
+    try:
+        co = c.coordinator
+        co.create_index("c9")
+        co.create_field("c9", "f")
+        n_shards, per_shard = 6, _n(50_000)
+        for shard in range(n_shards):
+            rows = rng.integers(0, 8, per_shard)
+            cols = shard * SHARD_WIDTH + np.arange(per_shard)
+            # remote portions of a cluster import ride HTTP+JSON: plain ints
+            co.import_bits("c9", "f", rows=rows.tolist(), cols=cols.tolist())
+        q = "Count(Row(f=3))"
+        want = co.query("c9", q)  # no-fault ground truth
+        victim = next(n.node.id for n in c.nodes[1:]
+                      if n.holder.index("c9").shards())
+        iters = max(QUERY_ITERS, 5)
+
+        def timed():
+            t0 = time.perf_counter()
+            r = co.query("c9", q)
+            return r, time.perf_counter() - t0
+
+        healthy = []
+        for _ in range(iters):
+            r, s = timed()
+            assert r == want
+            healthy.append(s)
+        delay_s = min(max(10 * statistics.median(healthy), 0.25), 2.0)
+
+        # unhedged: the plain fan-out waits out the straggler every time
+        plan.delay(victim, delay_s)
+        unhedged = []
+        for _ in range(iters):
+            r, s = timed()
+            assert r == want  # correct, just slow
+            unhedged.append(s)
+        plan.clear()
+
+        # hedged: warm the latency tracker fault-free, then re-inject.
+        # Huge breaker threshold isolates the hedging effect — the
+        # breaker would otherwise open and route around the victim,
+        # which also beats the straggle but isn't what's measured here.
+        reg = MetricsRegistry()
+        co.enable_resilience(registry=reg, hedge_min_ms=1.0,
+                             breaker_threshold=1 << 30)
+        for _ in range(iters):
+            r, s = timed()
+            assert r == want
+        plan.delay(victim, delay_s)
+        hedged = []
+        for _ in range(iters):
+            r, s = timed()
+            assert r == want  # bit-identical under the straggler
+            hedged.append(s)
+        plan.clear()
+        co.disable_resilience()
+        counters = reg.as_json()["counters"]
+        hedges = sum(v for k, v in counters.items()
+                     if k.startswith("cluster_hedges_total"))
+        wins = sum(v for k, v in counters.items()
+                   if k.startswith("cluster_hedge_wins_total"))
+    finally:
+        c.close()
+
+    def pct(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    hedged_p99 = pct(hedged, 0.99)
+    _emit(f"c9_hedged_straggler_fanout_p99{SCALED} ({device})", hedged_p99,
+          "ms", pct(unhedged, 0.99) / max(hedged_p99, 1e-6),
+          p99_unhedged_ms=pct(unhedged, 0.99),
+          p50_hedged_ms=pct(hedged, 0.5),
+          p50_unhedged_ms=pct(unhedged, 0.5),
+          p50_healthy_ms=pct(healthy, 0.5),
+          injected_delay_ms=delay_s * 1e3,
+          hedges=hedges, hedge_wins=wins,
+          floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -716,6 +814,7 @@ _CONFIGS = {
     "6": bench_config6,
     "7": bench_config7,
     "8": bench_config8,
+    "9": bench_config9,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

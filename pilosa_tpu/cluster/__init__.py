@@ -11,13 +11,17 @@ from pilosa_tpu.cluster.broadcast import (  # noqa: F401
     Broadcaster, HTTPBroadcaster, NopBroadcaster,
 )
 from pilosa_tpu.cluster.client import (  # noqa: F401
-    InternalClient, NodeDownError, RemoteError,
+    InternalClient, LegCancelled, NodeDownError, RemoteError,
 )
 from pilosa_tpu.cluster.disco import (  # noqa: F401
     DisCo, InMemDisCo, SingleNodeDisCo, StaticDisCo,
 )
 from pilosa_tpu.cluster.executor import ClusterExecutor  # noqa: F401
 from pilosa_tpu.cluster.harness import LocalCluster  # noqa: F401
+from pilosa_tpu.cluster.resilience import (  # noqa: F401
+    CancellationToken, CircuitBreaker, FaultPlan, InjectedFault,
+    LatencyTracker, Resilience,
+)
 from pilosa_tpu.hashing import (  # noqa: F401
     fnv64a, jump_hash, key_to_partition, shard_to_partition,
 )

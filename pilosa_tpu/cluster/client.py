@@ -15,6 +15,7 @@ host-to-host traffic (and the control plane).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import time
 import urllib.error
@@ -34,24 +35,50 @@ class RemoteError(RuntimeError):
         self.status = status
 
 
+class LegCancelled(RuntimeError):
+    """This leg's cancellation token fired (it lost a hedge race or its
+    query completed/expired). Deliberately NOT an OSError/ConnectionError:
+    the retry loop must not swallow it and the executor must not count it
+    as a node failure."""
+
+
 class InternalClient:
     def __init__(self, timeout: float = 30.0, retries: int = 2,
-                 backoff: float = 0.05):
+                 backoff: float = 0.05, sleep=None, rng=None,
+                 fault_plan=None):
         self.timeout = timeout
         self.retries = retries
         self.backoff = backoff
+        # Injectable for tests (sched/clock.py clocks provide .wait); the
+        # retry path never calls bare time.sleep directly.
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = rng if rng is not None else random.Random()
+        # Optional cluster/resilience.FaultPlan consulted before every
+        # send, keyed on the target node id (duck-typed: anything with
+        # on_request(node_id, token=)).
+        self.fault_plan = fault_plan
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
-                 ctype: str = "application/json") -> dict:
+                 ctype: str = "application/json", node_id: Optional[str] = None,
+                 token=None) -> dict:
         last: Optional[Exception] = None
         for attempt in range(self.retries + 1):
+            if token is not None and token.cancelled:
+                raise LegCancelled(f"request to {node_id or url} cancelled")
+            # Per-leg adaptive timeout (resilience.leg_timeout_s) caps the
+            # fixed client default when a token carries one.
+            timeout = self.timeout
+            if token is not None and token.timeout_s is not None:
+                timeout = max(1e-3, min(timeout, token.timeout_s))
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
                 req.add_header("Content-Type", ctype)
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if self.fault_plan is not None and node_id is not None:
+                    self.fault_plan.on_request(node_id, token=token)
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
                     data = resp.read()
                     return json.loads(data) if data else {}
             except urllib.error.HTTPError as e:
@@ -64,25 +91,40 @@ class InternalClient:
             except (urllib.error.URLError, socket.timeout, OSError) as e:
                 last = e
                 if attempt < self.retries:
-                    time.sleep(self.backoff * (2 ** attempt))
+                    # Jittered exponential backoff: full-jitter over
+                    # [0.5x, 1.5x) of the nominal step so synchronized
+                    # retry storms against a recovering peer decorrelate.
+                    delay = (self.backoff * (2 ** attempt)
+                             * (0.5 + self._rng.random()))
+                    if token is not None:
+                        if token.wait(delay):
+                            raise LegCancelled(
+                                f"request to {node_id or url} cancelled "
+                                f"during backoff") from None
+                    else:
+                        self._sleep(delay)
         raise NodeDownError(str(last))
 
-    def _post(self, node, path: str, payload: dict) -> dict:
+    def _post(self, node, path: str, payload: dict, token=None) -> dict:
         return self._request("POST", node.uri + path,
-                             json.dumps(payload).encode())
+                             json.dumps(payload).encode(),
+                             node_id=node.id, token=token)
 
-    def _get(self, node, path: str) -> dict:
-        return self._request("GET", node.uri + path)
+    def _get(self, node, path: str, token=None) -> dict:
+        return self._request("GET", node.uri + path, node_id=node.id,
+                             token=token)
 
     # -- query fan-out (reference: internal_client.go:602 QueryNode) -------
 
     def query_node(self, node, index: str, pql: str,
-                   shards: Sequence[int]) -> List[dict]:
+                   shards: Sequence[int], token=None) -> List[dict]:
         """Run `pql` for the given shards on a peer; results come back as
-        wire-tagged JSON (pql/result.py result_to_wire)."""
+        wire-tagged JSON (pql/result.py result_to_wire). ``token`` is a
+        resilience.CancellationToken: a cancelled token aborts the leg
+        between retries, and its timeout_s caps the transport timeout."""
         out = self._post(node, f"/internal/index/{index}/query", {
             "query": pql, "shards": list(shards), "remote": True,
-        })
+        }, token=token)
         return out["results"]
 
     # -- imports (reference: internal_client.go:691-931) -------------------
@@ -151,9 +193,11 @@ class InternalClient:
     # -- SQL subtree fanout (reference: /sql-exec-graph,
     #    http_handler.go:538 + sql3/planner/wireprotocol.go) --------------
 
-    def sql_subtree(self, node, spec: dict, shards: Sequence[int]) -> dict:
+    def sql_subtree(self, node, spec: dict, shards: Sequence[int],
+                    token=None) -> dict:
         return self._post(node, "/internal/sql/subtree",
-                          {"spec": spec, "shards": list(shards)})
+                          {"spec": spec, "shards": list(shards)},
+                          token=token)
 
     # -- control plane -----------------------------------------------------
 

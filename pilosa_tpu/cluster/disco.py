@@ -36,6 +36,17 @@ class DisCo:
     def cluster_state(self, replica_n: int = 1) -> str:
         return self.snapshot(replica_n).cluster_state(self.live_ids())
 
+    # Transport-level liveness hints from the executor/resilience layer
+    # (connection refused / breaker closed again). No-ops by default so
+    # every implementation exposes the surface; backends with real state
+    # (InMemDisCo, StaticDisCo, LeaseDisCo) override.
+
+    def mark_down(self, node_id: str) -> None:
+        pass
+
+    def mark_up(self, node_id: str) -> None:
+        pass
+
 
 class InMemDisCo(DisCo):
     """Shared-memory membership for in-process clusters (reference:
@@ -77,6 +88,10 @@ class InMemDisCo(DisCo):
     def is_live(self, node_id: str) -> bool:
         with self._lock:
             return self._live.get(node_id, False)
+
+    # the executor/resilience hints use the mark_* spelling
+    mark_down = down
+    mark_up = up
 
 
 class StaticDisCo(DisCo):

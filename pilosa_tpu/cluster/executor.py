@@ -64,6 +64,11 @@ class ClusterExecutor:
         # invalidate immediately; other writers are TTL-bounded).
         self.cache = None
         self._write_epoch: Dict[str, int] = {}
+        # optional fan-out resilience manager (cluster/resilience.py), set
+        # by ClusterNode.enable_resilience: hedged remote legs, per-node
+        # circuit breakers, adaptive per-leg timeouts. READ fan-outs only
+        # — the write path mirrors to every replica and never hedges.
+        self.resilience = None
         self.translator = ClusterTranslator(node_id, holder, client,
                                             snapshot_fn, live_fn=live_fn)
 
@@ -96,53 +101,103 @@ class ClusterExecutor:
 
     def _assign(self, snap: ClusterSnapshot, index: str,
                 shards: Sequence[int], dead: Set[str],
-                replica_rank: int = 0) -> Dict[str, List[int]]:
+                replica_rank: int = 0,
+                on_exhausted: str = "raise") -> Dict[str, List[int]]:
         """shard -> owning node at the given replica rank, skipping dead
-        nodes (reference: executor.go:6416 shardsByNode)."""
+        nodes (reference: executor.go:6416 shardsByNode). A rank beyond
+        the live owner list is EXPLICIT: ``on_exhausted="raise"`` surfaces
+        NodeDownError (never silently re-target the last owner — a hedge
+        would race the very node it's hedging against),
+        ``on_exhausted="skip"`` drops the shard from the assignment (the
+        write mirror pass has nothing left to mirror to)."""
         by_node: Dict[str, List[int]] = {}
         for s in shards:
             owners = [n for n in snap.shard_nodes(index, s) if n.id not in dead]
-            if not owners:
+            if replica_rank >= len(owners):
+                if on_exhausted == "skip":
+                    continue
                 raise NodeDownError(
-                    f"no live replica for shard {s} of index {index!r}")
-            n = owners[min(replica_rank, len(owners) - 1)]
+                    f"no live replica for shard {s} of index {index!r} "
+                    f"at rank {replica_rank} ({len(owners)} live owner(s))")
+            n = owners[replica_rank]
             by_node.setdefault(n.id, []).append(s)
         return by_node
 
     def _fan_shards(self, index: str, shards: Sequence[int],
-                    run_local, run_remote) -> List[Any]:
+                    run_local, run_remote,
+                    hedgeable: bool = True) -> List[Any]:
         """The shared fan-out + replica-failover loop: group shards by
         primary owner, run the local group on this thread while remote
         groups run concurrently (latency = max, not sum — the reference's
         mapper goroutines, executor.go:6579), and re-target failed
         nodes' shards at the next replica rank (executor.go:6500).
-        ``run_local(shards)`` / ``run_remote(node, shards)`` produce one
-        partial each; used by the PQL map/reduce AND SQL subtree fanout."""
+        ``run_local(shards)`` / ``run_remote(node, shards, token)``
+        produce one partial each; used by the PQL map/reduce AND SQL
+        subtree fanout. With a resilience manager attached the remote
+        wave also gets hedging, breaker routing and adaptive timeouts
+        (cluster/resilience.py)."""
         snap = self._snapshot_fn()
         nodes = {n.id: n for n in snap.nodes}
         # Seed with membership's view of dead peers (etcd heartbeats in
         # the reference); transport errors below add stragglers.
         dead: Set[str] = (set(nodes) - self._live_fn()
                           if self._live_fn is not None else set())
+        res = self.resilience
         pending = list(shards)
         parts: List[Any] = []
         for _attempt in range(max(1, snap.replica_n)):
             by_node = self._assign(snap, index, pending, dead)
-            failed: List[int] = []
+            if res is not None:
+                # Breaker routing: open-breaker nodes lose their legs to
+                # replicas up front (no timeout paid); when only vetoed
+                # owners remain, probe through the breaker rather than
+                # fail a query that could still succeed.
+                veto = res.vetoed(
+                    [nid for nid in by_node if nid != self.node_id])
+                if veto:
+                    try:
+                        by_node = self._assign(snap, index, pending,
+                                               dead | veto)
+                    except NodeDownError:
+                        pass
             remote = {nid: s for nid, s in by_node.items()
                       if nid != self.node_id}
-            with ThreadPoolExecutor(max_workers=max(1, len(remote))) as pool:
-                futs = {nid: pool.submit(run_remote, nodes[nid], s)
-                        for nid, s in remote.items()}
-                if self.node_id in by_node:
-                    parts.append(run_local(by_node[self.node_id]))
-                for nid, fut in futs.items():
-                    try:
-                        parts.append(fut.result())
-                    except NodeDownError:
-                        dead.add(nid)
+            local_shards = by_node.get(self.node_id)
+            if not remote:
+                # all-local fan-out: no thread pool, no tokens
+                if local_shards:
+                    parts.append(run_local(local_shards))
+                return parts
+            local_fn = ((lambda s=local_shards: run_local(s))
+                        if local_shards else None)
+            failed: List[int] = []
+            if res is not None:
+                def mark_failed(nid: str, transport: bool) -> None:
+                    dead.add(nid)
+                    if transport:
                         self._on_node_down(nid)
-                        failed.extend(remote[nid])
+
+                def next_owners(s, racing):
+                    return self._assign(snap, index, s, dead | {racing})
+
+                got, failed = res.run_legs(
+                    remote, nodes, run_remote, next_owners,
+                    hedgeable=hedgeable, local_fn=local_fn,
+                    mark_failed=mark_failed)
+                parts.extend(got)
+            else:
+                with ThreadPoolExecutor(max_workers=len(remote)) as pool:
+                    futs = {nid: pool.submit(run_remote, nodes[nid], s, None)
+                            for nid, s in remote.items()}
+                    if local_fn is not None:
+                        parts.append(local_fn())
+                    for nid, fut in futs.items():
+                        try:
+                            parts.append(fut.result())
+                        except NodeDownError:
+                            dead.add(nid)
+                            self._on_node_down(nid)
+                            failed.extend(remote[nid])
             if not failed:
                 return parts
             pending = failed
@@ -155,26 +210,27 @@ class ClusterExecutor:
         partial results (untranslated, untruncated)."""
         pql = call.to_pql()
 
-        def run_remote(node, s):
+        def run_remote(node, s, token=None):
             return R.result_from_wire(
-                self.client.query_node(node, idx.name, pql, s)[0])
+                self.client.query_node(node, idx.name, pql, s,
+                                       token=token)[0])
 
         cache = self.cache
         if cache is not None and cache.ttl_ms > 0:
             from pilosa_tpu.cache.keys import shard_key
 
-            def run_remote_cached(node, s, _raw=run_remote):
+            def run_remote_cached(node, s, token=None, _raw=run_remote):
                 # per-shard-leg partials: a later query overlapping only
                 # some of these shards still hits on the shared legs
                 key = ("rleg", idx.name, pql, shard_key(s),
                        self._write_epoch.get(idx.name, 0))
-                return cache.run(key, lambda: _raw(node, s))
+                return cache.run(key, lambda: _raw(node, s, token))
 
             run_remote = run_remote_cached
         return self._fan_shards(
             idx.name, shards,
             lambda s: self._run_local_read(idx.name, call, s),
-            run_remote)
+            run_remote, hedgeable=call.name not in _WRITE_CALLS)
 
     def _run_local_read(self, index: str, call: Call,
                         shards: Sequence[int]) -> Any:
@@ -206,8 +262,9 @@ class ClusterExecutor:
                 raise PQLError("sql_subtree needs the node API wrapper")
             return execute_subtree(api, spec, node_shards)
 
-        def run_remote(node, node_shards):
-            out = self.client.sql_subtree(node, spec, node_shards)
+        def run_remote(node, node_shards, token=None):
+            out = self.client.sql_subtree(node, spec, node_shards,
+                                          token=token)
             M.REGISTRY.count(M.METRIC_SQL_FANOUT_ROWS,
                              len(out.get("rows", [])))
             return out
@@ -391,7 +448,17 @@ class ClusterExecutor:
         # (reference: api.go Import forwarding with remote flag).
         result: Any = None
         for rank in range(snap.replica_n):
-            by_node = self._assign(snap, idx.name, shards, set(), rank)
+            # mirror pass: shards whose owner list is shorter than
+            # replica_n simply have no mirror at this rank
+            by_node = self._assign(snap, idx.name, shards, set(), rank,
+                                   on_exhausted="skip")
+            if set(by_node) == {self.node_id}:
+                # all-local: no thread pool
+                r = self._run_write_on(nodes[self.node_id], idx, call,
+                                       by_node[self.node_id])
+                if rank == 0:
+                    result = _merge_write(result, r)
+                continue
             with ThreadPoolExecutor(max_workers=max(1, len(by_node))) as pool:
                 futs = [pool.submit(self._run_write_on, nodes[nid], idx,
                                     call, nshards)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
+from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.disco import InMemDisCo
 from pilosa_tpu.cluster.node import ClusterNode
 from pilosa_tpu.server.http import serve
@@ -20,11 +21,19 @@ from pilosa_tpu.server.http import serve
 
 class LocalCluster:
     def __init__(self, n: int, replica_n: int = 1,
-                 base_path: Optional[str] = None, disco_factory=None):
+                 base_path: Optional[str] = None, disco_factory=None,
+                 fault_plan=None, client_factory=None):
         """``disco_factory()`` builds one DisCo per node (e.g. LeaseDisCo
         instances over a shared root — each node holds its own lease);
-        default is a single InMemDisCo shared by every node."""
+        default is a single InMemDisCo shared by every node.
+
+        ``fault_plan`` (cluster/resilience.FaultPlan) injects seeded
+        drops/delays/flaps into every node's inter-node client — the
+        deterministic chaos harness. ``client_factory(i)`` overrides
+        client construction per node entirely (it sees the same plan
+        only if it wires one itself)."""
         self.disco = InMemDisCo() if disco_factory is None else None
+        self.fault_plan = fault_plan
         self.nodes: List[ClusterNode] = []
         self._servers = []
         for i in range(n):
@@ -32,8 +41,14 @@ class LocalCluster:
             if path:
                 os.makedirs(path, exist_ok=True)
             disco = self.disco if disco_factory is None else disco_factory()
+            if client_factory is not None:
+                client = client_factory(i)
+            elif fault_plan is not None:
+                client = InternalClient(fault_plan=fault_plan)
+            else:
+                client = None
             node = ClusterNode(f"node{i}", "", disco, path=path,
-                               replica_n=replica_n)
+                               replica_n=replica_n, client=client)
             srv, _ = serve(node, port=0, background=True)
             host, port = srv.server_address[:2]
             node.node.uri = f"http://{host}:{port}"

@@ -21,7 +21,9 @@ plain API, so both layers work unchanged against a node.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 from pilosa_tpu.api import API
@@ -81,6 +83,15 @@ class ClusterNode:
 
     def _mark_down(self, node_id: str) -> None:
         for meth in ("down", "mark_down"):
+            fn = getattr(self.disco, meth, None)
+            if fn is not None:
+                fn(node_id)
+                return
+
+    def _mark_up(self, node_id: str) -> None:
+        """A recovered node rejoins membership (wired to the resilience
+        breaker's open -> closed transition)."""
+        for meth in ("up", "mark_up"):
             fn = getattr(self.disco, meth, None)
             if fn is not None:
                 fn(node_id)
@@ -193,16 +204,28 @@ class ClusterNode:
         q = parse(pql) if isinstance(pql, str) else pql
         is_write = any(c.name in _WRITE_CALLS for c in q.calls)
         self._check_state(write=is_write)
-        sched = self.executor.scheduler
-        if sched is not None and not is_write:
-            # one admission ticket per client query; the per-shard local
-            # kernels inside the fan-out micro-batch via the scheduler
-            kw = {}
-            if priority is not None:
-                kw["priority"] = priority
-            with sched.admit(**kw):
-                return self.executor.execute(index, q, shards=shards)
-        return self.executor.execute(index, q, shards=shards)
+        # Per-query deadline budget: visible to the fan-out's resilience
+        # layer, which caps every remote leg's timeout/hedge by what's
+        # left (sched/deadline.py).
+        if deadline_ms is not None and deadline_ms > 0:
+            from pilosa_tpu.sched.deadline import Deadline, deadline_scope
+
+            ctx = deadline_scope(Deadline(
+                time.monotonic() + deadline_ms / 1e3))
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            sched = self.executor.scheduler
+            if sched is not None and not is_write:
+                # one admission ticket per client query; the per-shard
+                # local kernels inside the fan-out micro-batch via the
+                # scheduler
+                kw = {}
+                if priority is not None:
+                    kw["priority"] = priority
+                with sched.admit(**kw):
+                    return self.executor.execute(index, q, shards=shards)
+            return self.executor.execute(index, q, shards=shards)
 
     def query_json(self, index: str, pql: str,
                    priority: Optional[str] = None,
@@ -256,6 +279,27 @@ class ClusterNode:
     def disable_cache(self) -> None:
         self.executor.cache = None
         self.executor.local.cache = None
+
+    # -- fan-out resilience (cluster/resilience.py) ------------------------
+
+    @property
+    def resilience(self):
+        return self.executor.resilience
+
+    def enable_resilience(self, config=None, **overrides):
+        """Attach hedged remote legs + per-node circuit breakers +
+        adaptive leg timeouts to this coordinator's fan-out. A breaker
+        closing (node recovered) marks the node back up in membership so
+        it rejoins assignment."""
+        from pilosa_tpu.cluster.resilience import Resilience
+
+        overrides.setdefault("on_node_up", self._mark_up)
+        res = Resilience.from_config(config, **overrides)
+        self.executor.resilience = res
+        return res
+
+    def disable_resilience(self) -> None:
+        self.executor.resilience = None
 
     def read_executor(self):
         """SQL read plans run against the cluster executor either way —

@@ -119,6 +119,27 @@ class Config:
     cache_max_bytes: int = 64 << 20
     cache_max_entries: int = 4096
     cache_ttl_ms: float = 0.0  # <=0: no TTL (and remote-leg caching off)
+    # fan-out resilience ([cluster.resilience] section /
+    # PILOSA_TPU_CLUSTER_RESILIENCE_*): hedged remote shard legs,
+    # per-node circuit breakers, adaptive per-leg timeouts
+    # (cluster/resilience.py; attach via ClusterNode.enable_resilience)
+    cluster_resilience_enabled: bool = False
+    cluster_resilience_hedge: bool = True
+    # hedge a leg once it's been outstanding past this percentile of the
+    # node's recent leg latencies, clamped to [hedge-min-ms, hedge-max-ms]
+    cluster_resilience_hedge_percentile: float = 95.0
+    cluster_resilience_hedge_min_ms: float = 2.0
+    cluster_resilience_hedge_max_ms: float = 2000.0
+    # consecutive transport failures/timeouts that open a node's breaker,
+    # and how long it stays open before a half-open probe is allowed
+    cluster_resilience_breaker_threshold: int = 3
+    cluster_resilience_breaker_open_ms: float = 3000.0
+    # per-leg timeout = timeout-factor x node p99, clamped to
+    # [timeout-min-ms, timeout-max-ms] and to the query's deadline budget
+    cluster_resilience_timeout_factor: float = 4.0
+    cluster_resilience_timeout_min_ms: float = 50.0
+    cluster_resilience_timeout_max_ms: float = 30000.0
+    cluster_resilience_latency_window: int = 64  # rolling samples per node
 
     # -- sources -----------------------------------------------------------
 
@@ -161,13 +182,22 @@ class Config:
         else:
             with open(path, encoding="utf-8") as f:
                 doc = _parse_toml_subset(f.read())
+        # [section] key -> section_key; dotted sections nest with real
+        # tomllib ([cluster.resilience] -> {"cluster": {"resilience":
+        # ...}}) but stay dotted flat keys in the subset parser — both
+        # flatten to cluster_resilience_*
         flat: Dict[str, Any] = {}
-        for k, v in doc.items():
-            if isinstance(v, dict):  # [section] key -> section_key
-                for k2, v2 in v.items():
-                    flat[f"{k}_{k2}".replace("-", "_")] = v2
-            else:
-                flat[k.replace("-", "_")] = v
+
+        def _flatten(prefix: str, d: Dict[str, Any]) -> None:
+            for k, v in d.items():
+                key = (f"{prefix}_{k}" if prefix else k) \
+                    .replace("-", "_").replace(".", "_")
+                if isinstance(v, dict):
+                    _flatten(key, v)
+                else:
+                    flat[key] = v
+
+        _flatten("", doc)
         return flat
 
     @classmethod

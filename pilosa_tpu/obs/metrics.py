@@ -78,6 +78,20 @@ METRIC_CACHE_DISPATCH_LATENCY = "cache_dispatch_seconds"  # histogram
 # one bucket layout spans both so the two histograms compare directly
 CACHE_LATENCY_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
                          0.1, 0.25, 1.0)
+# cluster fan-out resilience (cluster/resilience.py): hedged remote legs
+# (launched / won the race), per-node breaker state (0=closed,
+# 1=half-open, 2=open) + transition counts, adaptive-timeout reaps, and
+# the per-leg latency distribution feeding the hedge percentile
+METRIC_CLUSTER_HEDGES = "cluster_hedges_total"
+METRIC_CLUSTER_HEDGE_WINS = "cluster_hedge_wins_total"
+METRIC_CLUSTER_BREAKER_STATE = "cluster_breaker_state"
+METRIC_CLUSTER_BREAKER_TRANSITIONS = "cluster_breaker_transitions_total"
+METRIC_CLUSTER_LEG_TIMEOUTS = "cluster_leg_timeouts_total"
+METRIC_CLUSTER_LEG_LATENCY = "cluster_leg_latency_ms"
+# loopback legs sit ~1-10ms; injected stragglers and WAN legs land in
+# the upper decades
+LEG_LATENCY_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                          500.0, 1000.0, 2500.0, 5000.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -68,9 +68,18 @@ def force_cpu_platform(n_devices: int | None = None):
 # interleaving. On non-CPU backends the runtime orders collectives on
 # per-device queues, so the guard degrades to a no-op there.
 #
+# Serializing launches alone is NOT enough on CPU: dispatch is async, so
+# a kernel launched under the lock keeps executing after release, and a
+# second thread's kernel can still interleave rendezvous with it (two
+# reader threads each end up blocked — one in block_until_ready, one in
+# np.asarray — on programs stuck waiting for each other's pool threads).
+# guarded_call therefore also blocks on the launched computation BEFORE
+# releasing the lock on CPU, so at most one sharded program is ever in
+# flight. TPU keeps fully async launches.
+#
 # The dispatch lock is strictly a LEAF lock: it is taken only around an
 # individual compiled-kernel invocation (guarded_call) or device_put,
-# where the holder can block on nothing but the launch itself — never
+# where the holder can block on nothing but its own launch — never
 # around query/build phases that acquire holder.write_lock or perform
 # network I/O. That rule is what makes it deadlock-free by construction:
 # wrapping whole read paths instead inverts against writers (reads take
@@ -107,8 +116,14 @@ def guarded_call(fn):
 
     @functools.wraps(fn)
     def call(*args, **kwargs):
-        with dispatch_guard():
-            return fn(*args, **kwargs)
+        guard = dispatch_guard()
+        with guard:
+            out = fn(*args, **kwargs)
+            if guard is _DISPATCH_LOCK:
+                import jax
+
+                jax.block_until_ready(out)
+            return out
 
     call.__wrapped__ = fn
     return call

@@ -19,13 +19,17 @@ Layout:
 
 from pilosa_tpu.sched.batch import GroupKey, execute_batch, group_key
 from pilosa_tpu.sched.clock import ManualClock, MonotonicClock
+from pilosa_tpu.sched.deadline import (
+    Deadline, current_deadline, deadline_scope, remaining_budget_s,
+)
 from pilosa_tpu.sched.scheduler import (
     PRIORITY_BATCH, PRIORITY_INTERACTIVE, QueryScheduler, ScheduledQuery,
     SchedulingExecutor,
 )
 
 __all__ = [
-    "GroupKey", "ManualClock", "MonotonicClock", "PRIORITY_BATCH",
-    "PRIORITY_INTERACTIVE", "QueryScheduler", "ScheduledQuery",
-    "SchedulingExecutor", "execute_batch", "group_key",
+    "Deadline", "GroupKey", "ManualClock", "MonotonicClock",
+    "PRIORITY_BATCH", "PRIORITY_INTERACTIVE", "QueryScheduler",
+    "ScheduledQuery", "SchedulingExecutor", "current_deadline",
+    "deadline_scope", "execute_batch", "group_key", "remaining_budget_s",
 ]

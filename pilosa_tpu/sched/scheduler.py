@@ -447,9 +447,18 @@ class QueryScheduler:
         return keep
 
     def _dispatch(self, batch: List[_Pending]) -> None:
+        from pilosa_tpu.sched.deadline import Deadline, deadline_scope
+
         family = batch[0].key.family
+        # Publish the batch's tightest deadline as the dispatch-side
+        # budget: downstream layers (cluster fan-out leg timeouts,
+        # hedges) cap their waits by what's left of it.
+        deadlines = [p.deadline for p in batch if p.deadline is not None]
+        scope = (deadline_scope(Deadline(min(deadlines), self.clock.now))
+                 if deadlines else deadline_scope(None))
         t0 = time.perf_counter()
-        execute_batch(self.executor, batch)
+        with scope:
+            execute_batch(self.executor, batch)
         elapsed = time.perf_counter() - t0
         self.registry.observe_bucketed(
             obs_metrics.METRIC_SCHED_BATCH_SIZE, len(batch),

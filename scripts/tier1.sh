@@ -21,6 +21,16 @@ for seed in 0 1; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== fault-injection lane (PILOSA_TPU_FAULT_SEED=1 / 7) =="
+# The resilience tests must hold for ANY fault seed (seeds steer only
+# prob-gated rules); two fixed seeds keep the chaos reproducible while
+# still exercising two distinct injected-fault schedules.
+for seed in 1 7; do
+    PILOSA_TPU_FAULT_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_resilience.py -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,723 @@
+"""Fan-out resilience tests: cancellation tokens, latency tracking,
+circuit-breaker state machine, deterministic fault injection, hedged-leg
+races, adaptive timeouts, and end-to-end cluster behavior under a
+FaultPlan (straggler hedging bit-identical to the no-fault oracle,
+replica failover under flapping nodes, breaker-driven recovery).
+
+scripts/tier1.sh re-runs this file under two fixed values of
+PILOSA_TPU_FAULT_SEED — every test must hold for ANY seed: seeds only
+steer `prob` rules, and tests that pin exact fault sequences construct
+their plans with explicit seeds."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster import (
+    CancellationToken, CircuitBreaker, FaultPlan, InjectedFault,
+    LatencyTracker, LegCancelled, LocalCluster, NodeDownError, Resilience,
+)
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.executor import ClusterExecutor
+from pilosa_tpu.cluster.resilience import (
+    BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+)
+from pilosa_tpu.cluster.topology import ClusterSnapshot, Node
+from pilosa_tpu.config import Config
+from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.obs.metrics import MetricsRegistry
+from pilosa_tpu.sched import Deadline, ManualClock, deadline_scope
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def make_nodes(n):
+    return [Node(id=f"node{i}", uri=f"http://host{i}") for i in range(n)]
+
+
+class TestCancellationToken:
+    def test_starts_clear_and_cancels(self):
+        tok = CancellationToken(timeout_s=1.5)
+        assert not tok.cancelled
+        assert tok.timeout_s == 1.5
+        assert tok.wait(0.0) is False
+        tok.cancel()
+        assert tok.cancelled
+        # wait returns immediately once cancelled, whatever the timeout
+        assert tok.wait(60.0) is True
+
+    def test_cancel_wakes_a_waiter(self):
+        tok = CancellationToken()
+        woke = []
+        t = threading.Thread(target=lambda: woke.append(tok.wait(5.0)))
+        t.start()
+        tok.cancel()
+        t.join(timeout=2.0)
+        assert woke == [True]
+
+
+class TestLatencyTracker:
+    def test_empty_returns_none(self):
+        tr = LatencyTracker()
+        assert tr.percentile("a", 99.0) is None
+
+    def test_exact_percentiles_per_node(self):
+        tr = LatencyTracker(window=32)
+        for v in [3, 1, 2, 5, 4, 7, 6, 9, 8, 10]:
+            tr.observe("a", float(v))
+        assert tr.percentile("a", 0.0) == 1.0
+        assert tr.percentile("a", 50.0) == 6.0  # idx int(0.5*10)=5
+        assert tr.percentile("a", 100.0) == 10.0
+
+    def test_unknown_node_falls_back_to_global_window(self):
+        tr = LatencyTracker()
+        tr.observe("a", 2.0)
+        tr.observe("b", 4.0)
+        assert tr.percentile("never-seen", 100.0) == 4.0
+
+    def test_window_bounds_samples(self):
+        tr = LatencyTracker(window=4)
+        for v in range(1, 11):
+            tr.observe("a", float(v))
+        # only the last 4 samples (7..10) survive
+        assert tr.percentile("a", 0.0) == 7.0
+        assert tr.percentile("a", 100.0) == 10.0
+
+
+class TestCircuitBreaker:
+    def _mk(self, threshold=2, open_s=5.0):
+        clk = ManualClock()
+        reg = MetricsRegistry()
+        transitions = []
+        br = CircuitBreaker(
+            threshold=threshold, open_s=open_s, clock=clk, registry=reg,
+            on_transition=lambda n, frm, to: transitions.append((frm, to)))
+        return br, clk, reg, transitions
+
+    def test_full_state_machine(self):
+        br, clk, reg, transitions = self._mk()
+        assert br.state("x") == BREAKER_CLOSED
+        assert br.allow("x") is True
+        br.record_failure("x")
+        assert br.state("x") == BREAKER_CLOSED  # below threshold
+        br.record_failure("x")
+        assert br.state("x") == BREAKER_OPEN
+        assert br.allow("x") is False  # open and not yet expired
+        clk.advance(5.0)
+        assert br.allow("x") is True  # the half-open probe grant
+        assert br.state("x") == BREAKER_HALF_OPEN
+        br.record_failure("x")  # probe failed: straight back to open
+        assert br.state("x") == BREAKER_OPEN
+        clk.advance(5.0)
+        assert br.allow("x") is True
+        br.record_success("x")
+        assert br.state("x") == BREAKER_CLOSED
+        assert transitions == [
+            (BREAKER_CLOSED, BREAKER_OPEN),
+            (BREAKER_OPEN, BREAKER_HALF_OPEN),
+            (BREAKER_HALF_OPEN, BREAKER_OPEN),
+            (BREAKER_OPEN, BREAKER_HALF_OPEN),
+            (BREAKER_HALF_OPEN, BREAKER_CLOSED),
+        ]
+        # observable via metrics: gauge back at closed=0, counters per state
+        assert reg.value(M.METRIC_CLUSTER_BREAKER_STATE, node="x") == 0.0
+        assert reg.value(M.METRIC_CLUSTER_BREAKER_TRANSITIONS,
+                         node="x", to=BREAKER_OPEN) == 2.0
+        assert reg.value(M.METRIC_CLUSTER_BREAKER_TRANSITIONS,
+                         node="x", to=BREAKER_CLOSED) == 1.0
+
+    def test_single_probe_with_expiring_grant(self):
+        br, clk, _, _ = self._mk(threshold=1, open_s=2.0)
+        br.record_failure("x")
+        clk.advance(2.0)
+        assert br.allow("x") is True  # probe granted
+        assert br.allow("x") is False  # second leg vetoed while probing
+        # the probing query died without reporting; grant expires
+        clk.advance(2.0)
+        assert br.allow("x") is True
+
+    def test_success_resets_failure_streak(self):
+        br, _, _, _ = self._mk(threshold=2)
+        br.record_failure("x")
+        br.record_success("x")
+        br.record_failure("x")
+        assert br.state("x") == BREAKER_CLOSED  # streak broken, not 2-in-a-row
+
+    def test_nodes_are_independent(self):
+        br, _, _, _ = self._mk(threshold=1)
+        br.record_failure("x")
+        assert br.state("x") == BREAKER_OPEN
+        assert br.state("y") == BREAKER_CLOSED
+        assert br.allow("y") is True
+
+
+class TestFaultPlan:
+    def test_drop_is_a_transport_error(self):
+        plan = FaultPlan(seed=1).drop("a")
+        with pytest.raises(InjectedFault) as ei:
+            plan.on_request("a")
+        assert isinstance(ei.value, OSError)
+        assert plan.events == [("a", 0, "drop")]
+
+    def test_untargeted_nodes_pass_and_do_not_count(self):
+        plan = FaultPlan(seed=1).drop("a")
+        for _ in range(3):
+            plan.on_request("b")  # no rules for b: no fault, no count
+        assert plan.seen("b") == 0
+        assert plan.events == []
+
+    def test_first_and_count_window(self):
+        plan = FaultPlan(seed=1).drop("a", first=2, count=2)
+        hit = []
+        for k in range(6):
+            try:
+                plan.on_request("a")
+                hit.append(False)
+            except InjectedFault:
+                hit.append(True)
+        assert hit == [False, False, True, True, False, False]
+
+    def test_flap_period(self):
+        plan = FaultPlan(seed=1).flap("a", period=3)
+        hit = []
+        for _ in range(7):
+            try:
+                plan.on_request("a")
+                hit.append(False)
+            except InjectedFault:
+                hit.append(True)
+        assert hit == [True, False, False, True, False, False, True]
+        assert [e[2] for e in plan.events] == ["flap"] * 3
+
+    def test_prob_rules_are_seed_deterministic(self):
+        def run(seed):
+            plan = FaultPlan(seed=seed).drop("a", prob=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    plan.on_request("a")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = run(3), run(3)
+        assert a == b  # same seed, same request order -> same faults
+        assert 0 < sum(a) < 32  # prob actually gates (not all/none)
+        # and the per-request decision stream is a pure function of
+        # (seed, node, k) — independent of PYTHONHASHSEED / process
+        assert FaultPlan(seed=3)._hit_rng("a", 0)() == \
+            FaultPlan(seed=3)._hit_rng("a", 0)()
+
+    def test_seed_defaults_from_env(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_FAULT_SEED", "41")
+        assert FaultPlan().seed == 41
+        monkeypatch.delenv("PILOSA_TPU_FAULT_SEED")
+        assert FaultPlan().seed == 0
+
+    def test_delay_uses_injectable_sleep(self):
+        slept = []
+        plan = FaultPlan(seed=1, sleep=slept.append).delay("a", 0.25)
+        plan.on_request("a")
+        assert slept == [0.25]
+        assert plan.events == [("a", 0, "delay")]
+
+    def test_delay_with_cancelled_token_raises_leg_cancelled(self):
+        plan = FaultPlan(seed=1).delay("a", 30.0)
+        tok = CancellationToken()
+        tok.cancel()
+        with pytest.raises(LegCancelled):
+            plan.on_request("a", token=tok)  # returns immediately, no sleep
+
+    def test_clear_disarms(self):
+        plan = FaultPlan(seed=1).drop("a").drop("b")
+        plan.clear("a")
+        plan.on_request("a")  # no longer armed
+        with pytest.raises(InjectedFault):
+            plan.on_request("b")
+        plan.clear()
+        plan.on_request("b")
+
+    def test_seen_tracks_armed_requests(self):
+        plan = FaultPlan(seed=1).delay("a", 0.0)
+        assert plan.seen("a") == 0
+        plan.on_request("a")
+        plan.on_request("a")
+        assert plan.seen("a") == 2
+
+
+class TestClientRetry:
+    # nothing listens on port 1: instant connection-refused
+    DEAD_URL = "http://127.0.0.1:1/x"
+
+    def test_jittered_backoff_between_retries(self):
+        slept = []
+        c = InternalClient(timeout=0.2, retries=2, backoff=0.05,
+                           sleep=slept.append, rng=random.Random(0))
+        with pytest.raises(NodeDownError):
+            c._request("GET", self.DEAD_URL)
+        # full-jitter over [0.5x, 1.5x) of backoff * 2^attempt
+        assert len(slept) == 2
+        assert 0.025 <= slept[0] < 0.075
+        assert 0.05 <= slept[1] < 0.15
+
+    def test_jitter_draws_come_from_injected_rng(self):
+        r = random.Random(7)
+        want = [0.05 * (0.5 + r.random()), 0.1 * (0.5 + r.random())]
+        slept = []
+        c = InternalClient(timeout=0.2, retries=2, backoff=0.05,
+                           sleep=slept.append, rng=random.Random(7))
+        with pytest.raises(NodeDownError):
+            c._request("GET", self.DEAD_URL)
+        assert slept == pytest.approx(want)
+
+    def test_cancelled_token_aborts_before_any_attempt(self):
+        slept = []
+        c = InternalClient(retries=2, sleep=slept.append)
+        tok = CancellationToken()
+        tok.cancel()
+        with pytest.raises(LegCancelled):
+            c._request("GET", self.DEAD_URL, token=tok)
+        assert slept == []
+
+    def test_fault_plan_drop_surfaces_as_node_down(self):
+        plan = FaultPlan(seed=1).drop("nodeX")
+        slept = []
+        c = InternalClient(retries=1, backoff=0.0, sleep=slept.append,
+                           fault_plan=plan)
+        with pytest.raises(NodeDownError):
+            c._request("GET", self.DEAD_URL, node_id="nodeX")
+        # both attempts consulted the plan (drop, retry, drop again)
+        assert [e[2] for e in plan.events] == ["drop", "drop"]
+        assert len(slept) == 1
+
+
+class TestAssign:
+    def _ex(self):
+        # _assign is pure placement math over its arguments
+        return ClusterExecutor.__new__(ClusterExecutor)
+
+    def test_rank_beyond_owners_raises_not_clamps(self):
+        ex = self._ex()
+        snap = ClusterSnapshot(make_nodes(3), replica_n=2)
+        by0 = ex._assign(snap, "i", [0, 1, 2], set(), replica_rank=0)
+        by1 = ex._assign(snap, "i", [0, 1, 2], set(), replica_rank=1)
+        for s in (0, 1, 2):
+            r0 = next(n for n, ss in by0.items() if s in ss)
+            r1 = next(n for n, ss in by1.items() if s in ss)
+            assert r0 != r1  # ranks are distinct owners, never clamped
+        with pytest.raises(NodeDownError, match="no live replica"):
+            ex._assign(snap, "i", [0], set(), replica_rank=2)
+
+    def test_dead_filter_never_falls_back_to_racing_owner(self):
+        ex = self._ex()
+        snap = ClusterSnapshot(make_nodes(3), replica_n=2)
+        owners = [n.id for n in snap.shard_nodes("i", 0)]
+        # rank 1 with the rank-1 owner dead: the old clamp would hand the
+        # shard back to owners[0] — the node a hedge would be racing
+        with pytest.raises(NodeDownError):
+            ex._assign(snap, "i", [0], {owners[1]}, replica_rank=1)
+
+    def test_on_exhausted_skip_drops_the_shard(self):
+        ex = self._ex()
+        snap = ClusterSnapshot(make_nodes(3), replica_n=2)
+        assert ex._assign(snap, "i", [0], set(), replica_rank=2,
+                          on_exhausted="skip") == {}
+
+    def test_all_owners_dead_raises(self):
+        ex = self._ex()
+        snap = ClusterSnapshot(make_nodes(3), replica_n=2)
+        owners = {n.id for n in snap.shard_nodes("i", 0)}
+        with pytest.raises(NodeDownError):
+            ex._assign(snap, "i", [0], owners)
+
+
+def _park(token, then=None):
+    """A remote leg that blocks until cancelled (a straggler)."""
+    if token.wait(10.0):
+        raise LegCancelled("parked leg cancelled")
+    raise AssertionError("parked leg was never cancelled")
+
+
+class TestRunLegs:
+    def _res(self, reg, **kw):
+        kw.setdefault("hedge_min_ms", 1.0)
+        kw.setdefault("hedge_max_ms", 1.0)
+        return Resilience(registry=reg, **kw)
+
+    def test_hedge_wins_over_parked_primary(self):
+        reg = MetricsRegistry()
+        res = self._res(reg)
+        racing = []
+
+        def run_remote(node, shards, token):
+            if node == "A":
+                _park(token)
+            return ("part", node, tuple(shards))
+
+        def next_owners(shards, racing_node):
+            racing.append(racing_node)
+            return {"b": list(shards)}
+
+        parts, failed = res.run_legs(
+            {"a": [1, 2]}, {"a": "A", "b": "B"}, run_remote, next_owners)
+        assert parts == [("part", "B", (1, 2))]
+        assert failed == []
+        assert racing == ["a"]
+        assert reg.value(M.METRIC_CLUSTER_HEDGES) == 1.0
+        assert reg.value(M.METRIC_CLUSTER_HEDGE_WINS) == 1.0
+
+    def test_primary_wins_after_hedge_wave_breaks(self):
+        reg = MetricsRegistry()
+        res = self._res(reg)
+        marks = []
+
+        def run_remote(node, shards, token):
+            if node == "B":
+                raise NodeDownError("replica down")
+            token.wait(0.03)  # slow but healthy primary
+            return "pa"
+
+        parts, failed = res.run_legs(
+            {"a": [1]}, {"a": "A", "b": "B"}, run_remote,
+            lambda s, r: {"b": list(s)},
+            mark_failed=lambda n, t: marks.append((n, t)))
+        assert parts == ["pa"]
+        assert failed == []
+        assert reg.value(M.METRIC_CLUSTER_HEDGES) == 1.0
+        assert reg.value(M.METRIC_CLUSTER_HEDGE_WINS) == 0.0
+        assert ("b", True) in marks
+
+    def test_hedge_onto_racing_node_is_a_bug_not_a_retry(self):
+        reg = MetricsRegistry()
+        res = self._res(reg)
+        with pytest.raises(AssertionError, match="racing node"):
+            res.run_legs({"a": [1]}, {"a": "A"},
+                         lambda n, s, t: _park(t),
+                         lambda s, r: {"a": list(s)})
+
+    def test_no_replica_to_hedge_onto_is_quietly_skipped(self):
+        reg = MetricsRegistry()
+        res = self._res(reg)
+
+        def run_remote(node, shards, token):
+            token.wait(0.03)
+            return "pa"
+
+        def next_owners(shards, racing):
+            raise NodeDownError("no live replica")
+
+        parts, failed = res.run_legs({"a": [1]}, {"a": "A"}, run_remote,
+                                     next_owners)
+        assert parts == ["pa"] and failed == []
+        assert reg.value(M.METRIC_CLUSTER_HEDGES) == 0.0
+
+    def test_timeout_reaps_stuck_leg(self):
+        reg = MetricsRegistry()
+        res = Resilience(registry=reg, hedge=False,
+                         timeout_min_ms=20.0, timeout_max_ms=20.0)
+        marks = []
+        parts, failed = res.run_legs(
+            {"a": [3]}, {"a": "A"}, lambda n, s, t: _park(t),
+            lambda s, r: {}, mark_failed=lambda n, t: marks.append((n, t)))
+        assert parts == []
+        assert failed == [3]  # shard re-enters the executor failover loop
+        assert marks == [("a", False)]  # timeout is not a transport error
+        assert reg.value(M.METRIC_CLUSTER_LEG_TIMEOUTS, node="a") == 1.0
+
+    def test_primary_failure_without_hedge_fails_the_group(self):
+        reg = MetricsRegistry()
+        res = Resilience(registry=reg, hedge=False)
+        marks = []
+
+        def run_remote(node, shards, token):
+            raise NodeDownError("down")
+
+        parts, failed = res.run_legs(
+            {"a": [4, 5]}, {"a": "A"}, run_remote, lambda s, r: {},
+            mark_failed=lambda n, t: marks.append((n, t)))
+        assert parts == [] and sorted(failed) == [4, 5]
+        assert marks == [("a", True)]
+        assert res.breaker.state("a") == BREAKER_CLOSED  # 1 < threshold 3
+
+    def test_local_leg_runs_first_and_merges(self):
+        reg = MetricsRegistry()
+        res = Resilience(registry=reg, hedge=False)
+        parts, failed = res.run_legs(
+            {"a": [1]}, {"a": "A"}, lambda n, s, t: "ra", lambda s, r: {},
+            local_fn=lambda: "local")
+        assert parts == ["local", "ra"] and failed == []
+
+    def test_success_feeds_latency_tracker_and_breaker(self):
+        reg = MetricsRegistry()
+        res = Resilience(registry=reg, hedge=False)
+        res.run_legs({"a": [1]}, {"a": "A"}, lambda n, s, t: "ra",
+                     lambda s, r: {})
+        assert res.tracker.percentile("a", 99.0) is not None
+        assert res.breaker.state("a") == BREAKER_CLOSED
+        # leg latency histogram observed under outcome=ok kind=primary
+        h = reg.histogram(M.METRIC_CLUSTER_LEG_LATENCY,
+                          outcome="ok", kind="primary")
+        assert h is not None and h["count"] == 1
+
+
+class TestAdaptivePolicies:
+    def test_leg_timeout_tracks_p99_with_clamps(self):
+        res = Resilience(timeout_factor=4.0, timeout_min_ms=50.0,
+                         timeout_max_ms=30000.0)
+        assert res.leg_timeout_s("a") == 30.0  # no samples: max
+        for _ in range(10):
+            res.tracker.observe("a", 0.001)
+        assert res.leg_timeout_s("a") == 0.05  # 4 x 1ms clamps up to min
+        for _ in range(64):
+            res.tracker.observe("a", 100.0)
+        assert res.leg_timeout_s("a") == 30.0  # 400s clamps down to max
+
+    def test_leg_timeout_respects_deadline_budget(self):
+        clk = ManualClock()
+        res = Resilience()
+        with deadline_scope(Deadline(clk.now() + 2.0, now=clk.now)):
+            assert res.leg_timeout_s("a") == 2.0
+            clk.advance(1.5)
+            assert res.leg_timeout_s("a") == pytest.approx(0.5)
+            clk.advance(1.0)
+            assert res.leg_timeout_s("a") == 0.0  # budget exhausted
+        assert res.leg_timeout_s("a") == 30.0  # scope cleared
+
+    def test_hedge_delay_clamps_to_bounds(self):
+        res = Resilience(hedge_min_ms=10.0, hedge_max_ms=100.0)
+        assert res.hedge_delay_s("a") == 0.01  # no samples: min
+        for _ in range(10):
+            res.tracker.observe("a", 50.0)
+        assert res.hedge_delay_s("a") == 0.1  # p95 clamps down to max
+
+    def test_vetoed_routes_open_breakers_to_replicas(self):
+        res = Resilience(breaker_threshold=1)
+        res.breaker.record_failure("b")
+        assert res.vetoed(["a", "b", "c"]) == {"b"}
+
+
+class TestConfig:
+    def test_toml_section_round_trips(self, tmp_path):
+        p = tmp_path / "pilosa.toml"
+        p.write_text(
+            "[cluster.resilience]\n"
+            "enabled = true\n"
+            "hedge-percentile = 90.0\n"
+            "breaker-threshold = 5\n"
+            "timeout-min-ms = 10.0\n")
+        cfg = Config.from_sources(toml_path=str(p), env={})
+        assert cfg.cluster_resilience_enabled is True
+        assert cfg.cluster_resilience_hedge_percentile == 90.0
+        assert cfg.cluster_resilience_breaker_threshold == 5
+        assert cfg.cluster_resilience_timeout_min_ms == 10.0
+        res = Resilience.from_config(cfg)
+        assert res.hedge_percentile == 90.0
+        assert res.breaker.threshold == 5
+        assert res.timeout_min_s == 0.01
+
+    def test_env_override(self):
+        cfg = Config.from_sources(
+            env={"PILOSA_TPU_CLUSTER_RESILIENCE_HEDGE_MIN_MS": "7.5",
+                 "PILOSA_TPU_CLUSTER_RESILIENCE_HEDGE": "false"})
+        assert cfg.cluster_resilience_hedge_min_ms == 7.5
+        res = Resilience.from_config(cfg)
+        assert res.hedge_min_s == pytest.approx(0.0075)
+        assert res.hedge is False
+
+    def test_overrides_beat_config(self):
+        res = Resilience.from_config(Config(), breaker_threshold=1)
+        assert res.breaker.threshold == 1
+
+
+def _fill(target, index):
+    """Same dataset through any node/API surface (mirrors test_cluster)."""
+    target.create_index(index)
+    target.create_field(index, "f")
+    rows, cols = [], []
+    for c in range(0, 5 * SHARD_WIDTH, SHARD_WIDTH // 4):
+        rows.append((c // 100) % 3)
+        cols.append(c)
+    target.import_bits(index, "f", rows=rows, cols=cols)
+    return index
+
+
+def _remote_primary(co, index):
+    """A non-coordinator node owning rank-0 shards of `index` from the
+    coordinator's current assignment."""
+    ex = co.executor
+    snap = ex._snapshot_fn()
+    by_node = ex._assign(snap, index, sorted(ex._shards_fn(index)), set())
+    return next(nid for nid in by_node if nid != ex.node_id)
+
+
+class TestClusterFaultInjection:
+    """End-to-end over LocalCluster + FaultPlan: real HTTP legs, seeded
+    faults at the client boundary, results checked against a no-fault
+    single-node oracle."""
+
+    def test_all_local_fanout_uses_no_thread_pool(self, monkeypatch):
+        c = LocalCluster(1)
+        try:
+            _fill(c.coordinator, "rl")
+            want = c.coordinator.query("rl", "Count(Row(f=0))")
+
+            def boom(*a, **kw):
+                raise AssertionError("pool created for all-local fan-out")
+
+            monkeypatch.setattr(
+                "pilosa_tpu.cluster.executor.ThreadPoolExecutor", boom)
+            assert c.coordinator.query("rl", "Count(Row(f=0))") == want
+            c.coordinator.query("rl", f"Set({7 * SHARD_WIDTH}, f=1)")
+            assert c.coordinator.query("rl", "Count(Row(f=1))") != want
+        finally:
+            c.close()
+
+    @pytest.fixture()
+    def faulty_cluster(self):
+        plan = FaultPlan()  # seed from PILOSA_TPU_FAULT_SEED (tier1.sh lane)
+        c = LocalCluster(3, replica_n=2, fault_plan=plan)
+        try:
+            yield c, plan
+        finally:
+            c.close()
+
+    def test_hedged_straggler_matches_no_fault_oracle(self, faulty_cluster):
+        c, plan = faulty_cluster
+        oracle = API()
+        _fill(oracle, "hs")
+        _fill(c.coordinator, "hs")
+        q = "Count(Row(f=0))"
+        want = oracle.query("hs", q)
+
+        co = c.coordinator
+        reg = MetricsRegistry()
+        # huge breaker threshold isolates hedging from breaker routing
+        res = co.enable_resilience(registry=reg, hedge_min_ms=1.0,
+                                   breaker_threshold=1 << 30)
+        try:
+            for _ in range(3):  # warm the latency windows, fault-free
+                assert co.query("hs", q) == want
+            victim = _remote_primary(co, "hs")
+            plan.delay(victim, 2.0)
+            t0 = time.monotonic()
+            got = co.query("hs", q)
+            elapsed = time.monotonic() - t0
+            plan.clear()
+            assert got == want  # bit-identical despite the straggler
+            assert elapsed < 1.6  # hedge beat the 2s injected delay
+            assert sum(v for k, v in reg.as_json()["counters"].items()
+                       if M.METRIC_CLUSTER_HEDGES in str(k)) >= 1 \
+                or reg.value(M.METRIC_CLUSTER_HEDGES) >= 1.0
+            assert reg.value(M.METRIC_CLUSTER_HEDGE_WINS) >= 1.0
+            text = reg.prometheus_text()
+            assert "cluster_hedges_total" in text
+            assert "cluster_leg_latency_ms_bucket" in text
+        finally:
+            plan.clear()
+            co.disable_resilience()
+
+    def test_writes_never_enter_the_hedged_path(self, faulty_cluster):
+        c, plan = faulty_cluster
+        co = c.coordinator
+        _fill(co, "wh")
+        res = co.enable_resilience(hedge_min_ms=1.0)
+        calls = []
+        orig = res.run_legs
+
+        def spy(remote, nodes, run_remote, next_owners, **kw):
+            calls.append(kw.get("hedgeable"))
+            return orig(remote, nodes, run_remote, next_owners, **kw)
+
+        res.run_legs = spy
+        try:
+            co.query("wh", f"Set({9 * SHARD_WIDTH + 5}, f=2)")
+            assert calls == []  # the write mirror path bypasses run_legs
+            co.query("wh", "Count(Row(f=2))")
+            assert calls and all(h is True for h in calls)
+        finally:
+            co.disable_resilience()
+
+    def test_flap_recovers_within_client_retries(self, faulty_cluster):
+        # the flapping node fails attempt 1 and recovers before attempt 2:
+        # the client's jittered retry absorbs it — no failover, no
+        # membership change, answer identical to the no-fault oracle
+        c, plan = faulty_cluster
+        oracle = API()
+        _fill(oracle, "fr")
+        _fill(c.coordinator, "fr")
+        q = "Count(Row(f=0))"
+        want = oracle.query("fr", q)
+        co = c.coordinator
+        assert co.query("fr", q) == want  # warm, fault-free
+        victim = _remote_primary(co, "fr")
+        downs = []
+        orig_down = co.executor._on_node_down
+        co.executor._on_node_down = lambda nid: (downs.append(nid),
+                                                 orig_down(nid))
+        try:
+            plan.drop(victim, first=plan.seen(victim), count=1)
+            assert co.query("fr", q) == want
+            assert downs == []  # absorbed inside the client retry loop
+        finally:
+            co.executor._on_node_down = orig_down
+            plan.clear()
+
+    def test_failover_then_breaker_recovery(self):
+        # retries=0 clients: a drop surfaces immediately as NodeDownError,
+        # the leg fails over to the replica (answer still matches the
+        # oracle), the breaker opens, and after open_ms a half-open probe
+        # closes it again — firing on_node_up back into membership
+        plan = FaultPlan()
+        c = LocalCluster(
+            3, replica_n=2,
+            client_factory=lambda i: InternalClient(retries=0,
+                                                    fault_plan=plan))
+        try:
+            oracle = API()
+            _fill(oracle, "fo")
+            _fill(c.coordinator, "fo")
+            q = "Count(Row(f=0))"
+            want = oracle.query("fo", q)
+            co = c.coordinator
+            transitions = []
+            reg = MetricsRegistry()
+            res = co.enable_resilience(
+                registry=reg, hedge=False, breaker_threshold=1,
+                breaker_open_ms=100.0,
+                on_breaker_transition=lambda n, f, t: transitions.append(
+                    (n, f, t)))
+            try:
+                assert co.query("fo", q) == want  # warm, fault-free
+                victim = _remote_primary(co, "fo")
+                downs = []
+                orig_down = co.executor._on_node_down
+                co.executor._on_node_down = lambda nid: (
+                    downs.append(nid), orig_down(nid))
+                plan.drop(victim, first=plan.seen(victim), count=1)
+                assert co.query("fo", q) == want  # replica failover
+                co.executor._on_node_down = orig_down
+                assert downs == [victim]
+                assert res.breaker.state(victim) == BREAKER_OPEN
+                assert reg.value(M.METRIC_CLUSTER_BREAKER_STATE,
+                                 node=victim) == 2.0
+                # heartbeat sees the node again (the drop was injected;
+                # the server never actually died)
+                c.disco.up(victim)
+                time.sleep(0.15)  # breaker_open_ms elapses
+                assert co.query("fo", q) == want  # the half-open probe
+                assert res.breaker.state(victim) == BREAKER_CLOSED
+                assert [(f, t) for n, f, t in transitions
+                        if n == victim] == [
+                    (BREAKER_CLOSED, BREAKER_OPEN),
+                    (BREAKER_OPEN, BREAKER_HALF_OPEN),
+                    (BREAKER_HALF_OPEN, BREAKER_CLOSED),
+                ]
+                assert c.disco.is_live(victim)  # on_node_up rejoined it
+            finally:
+                co.disable_resilience()
+        finally:
+            plan.clear()
+            c.close()
