@@ -666,6 +666,83 @@ def bench_config9(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 10 — remote-leg stale-read window: gossip invalidation vs TTL
+# ---------------------------------------------------------------------------
+
+def bench_config10(device: str) -> None:
+    """2-node cluster; a remote shard's Count is cached on the
+    coordinator, then the OWNER node is written directly (bypassing the
+    coordinator, so the write-epoch gate never fires). The stale-read
+    window is the time from write completion until a polling read on
+    the coordinator sees the new count. TTL-only caching rides out the
+    TTL; gossip-keyed caching invalidates as soon as an anti-entropy
+    round (or piggyback) delivers the owner's new version — measurably
+    smaller, with zero TTL reliance."""
+    from pilosa_tpu.cluster import LocalCluster
+    from pilosa_tpu.obs.metrics import MetricsRegistry
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(10)
+    ttl_ms, gossip_interval_ms, trials = 300.0, 10.0, 8
+    c = LocalCluster(2)
+    try:
+        co = c.coordinator
+        co.create_index("c10")
+        co.create_field("c10", "f")
+        n_shards, per_shard = 4, _n(20_000)
+        for shard in range(n_shards):
+            rows = rng.integers(0, 8, per_shard)
+            cols = shard * SHARD_WIDTH + np.arange(per_shard)
+            co.import_bits("c10", "f", rows=rows.tolist(),
+                           cols=cols.tolist())
+        owner = next(n for n in c.nodes[1:]
+                     if n.holder.index("c10").shards())
+        shard = sorted(owner.holder.index("c10").shards())[0]
+        q = "Count(Row(f=3))"
+        next_col = [shard * SHARD_WIDTH + per_shard]
+
+        def stale_window() -> float:
+            """Warm the cache, write on the owner, poll until fresh."""
+            want = co.query("c10", q)[0] + 1
+            col, next_col[0] = next_col[0], next_col[0] + 1
+            owner.api.import_bits("c10", "f", rows=[3], cols=[col])
+            owner._announce_shards("c10")
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 5.0:
+                if co.query("c10", q)[0] >= want:
+                    return time.perf_counter() - t0
+                time.sleep(0.002)
+            return 5.0  # bailed: count the full budget as stale
+
+        # phase 1: TTL-only remote-leg caching (the pre-gossip gate)
+        co.enable_cache(ttl_ms=ttl_ms, registry=MetricsRegistry())
+        ttl_windows = [stale_window() for _ in range(trials)]
+        co.disable_cache()
+
+        # phase 2: gossip fingerprint keying, TTL knob at ZERO
+        c.enable_gossip(interval_ms=gossip_interval_ms, start=True,
+                        registry=MetricsRegistry())
+        c.run_gossip_rounds(3)  # converge before measuring
+        co.enable_cache(ttl_ms=0, registry=MetricsRegistry())
+        gossip_windows = [stale_window() for _ in range(trials)]
+    finally:
+        c.close()
+
+    def pct(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    g_p50 = pct(gossip_windows, 0.5)
+    _emit(f"c10_gossip_invalidation_p50{SCALED} ({device})", g_p50,
+          "ms", pct(ttl_windows, 0.5) / max(g_p50, 1e-6),
+          p50_ttl_ms=pct(ttl_windows, 0.5),
+          p99_gossip_ms=pct(gossip_windows, 0.99),
+          p99_ttl_ms=pct(ttl_windows, 0.99),
+          ttl_ms=ttl_ms, gossip_interval_ms=gossip_interval_ms,
+          trials=trials)
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -815,6 +892,7 @@ _CONFIGS = {
     "7": bench_config7,
     "8": bench_config8,
     "9": bench_config9,
+    "10": bench_config10,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

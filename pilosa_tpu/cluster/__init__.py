@@ -22,6 +22,7 @@ from pilosa_tpu.cluster.resilience import (  # noqa: F401
     CancellationToken, CircuitBreaker, FaultPlan, InjectedFault,
     LatencyTracker, Resilience,
 )
+from pilosa_tpu.gossip import GossipAgent, GossipState  # noqa: F401
 from pilosa_tpu.hashing import (  # noqa: F401
     fnv64a, jump_hash, key_to_partition, shard_to_partition,
 )

@@ -55,14 +55,19 @@ class InternalClient:
         self._rng = rng if rng is not None else random.Random()
         # Optional cluster/resilience.FaultPlan consulted before every
         # send, keyed on the target node id (duck-typed: anything with
-        # on_request(node_id, token=)).
+        # on_request(node_id, token=, op=)).
         self.fault_plan = fault_plan
+        # Optional gossip.GossipAgent: when set, query/import/broadcast
+        # requests carry a piggybacked gossip envelope and responses'
+        # envelopes are applied — dissemination at RPC speed with zero
+        # extra round-trips. ClusterNode.enable_gossip wires this.
+        self.gossip = None
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  ctype: str = "application/json", node_id: Optional[str] = None,
-                 token=None) -> dict:
+                 token=None, op: Optional[str] = None) -> dict:
         last: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             if token is not None and token.cancelled:
@@ -77,7 +82,7 @@ class InternalClient:
                 req.add_header("Content-Type", ctype)
             try:
                 if self.fault_plan is not None and node_id is not None:
-                    self.fault_plan.on_request(node_id, token=token)
+                    self.fault_plan.on_request(node_id, token=token, op=op)
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     data = resp.read()
                     return json.loads(data) if data else {}
@@ -105,14 +110,39 @@ class InternalClient:
                         self._sleep(delay)
         raise NodeDownError(str(last))
 
-    def _post(self, node, path: str, payload: dict, token=None) -> dict:
+    def _post(self, node, path: str, payload: dict, token=None,
+              op: Optional[str] = None) -> dict:
         return self._request("POST", node.uri + path,
                              json.dumps(payload).encode(),
-                             node_id=node.id, token=token)
+                             node_id=node.id, token=token, op=op)
 
-    def _get(self, node, path: str, token=None) -> dict:
+    def _get(self, node, path: str, token=None,
+             op: Optional[str] = None) -> dict:
         return self._request("GET", node.uri + path, node_id=node.id,
-                             token=token)
+                             token=token, op=op)
+
+    # -- gossip piggybacking (gossip/agent.py) ------------------------------
+
+    def _piggyback(self, node, payload: dict) -> dict:
+        """Return a copy of ``payload`` carrying a gossip envelope for the
+        target node (copy, not mutation: broadcast callers share one msg
+        dict across peers and each peer gets its own delta window)."""
+        g = self.gossip
+        if g is None:
+            return payload
+        out = dict(payload)
+        out["gossip"] = g.envelope(node.id)
+        from pilosa_tpu.obs import metrics as M
+        g.registry.count(M.METRIC_GOSSIP_PIGGYBACKS)
+        return out
+
+    def _apply_gossip(self, out) -> None:
+        """Apply the gossip envelope a server attached to its response."""
+        g = self.gossip
+        if g is not None and isinstance(out, dict):
+            env = out.get("gossip")
+            if isinstance(env, dict):
+                g.receive(env)
 
     # -- query fan-out (reference: internal_client.go:602 QueryNode) -------
 
@@ -122,9 +152,12 @@ class InternalClient:
         wire-tagged JSON (pql/result.py result_to_wire). ``token`` is a
         resilience.CancellationToken: a cancelled token aborts the leg
         between retries, and its timeout_s caps the transport timeout."""
-        out = self._post(node, f"/internal/index/{index}/query", {
-            "query": pql, "shards": list(shards), "remote": True,
-        }, token=token)
+        out = self._post(node, f"/internal/index/{index}/query",
+                         self._piggyback(node, {
+                             "query": pql, "shards": list(shards),
+                             "remote": True,
+                         }), token=token, op="query")
+        self._apply_gossip(out)
         return out["results"]
 
     # -- imports (reference: internal_client.go:691-931) -------------------
@@ -135,51 +168,60 @@ class InternalClient:
         return self._post(node, "/directive", payload)
 
     def import_bits(self, node, index: str, field: str, payload: dict) -> dict:
-        return self._post(node, f"/index/{index}/import", payload)
+        out = self._post(node, f"/index/{index}/import",
+                         self._piggyback(node, payload), op="import")
+        self._apply_gossip(out)
+        return out
 
     def import_values(self, node, index: str, field: str, payload: dict) -> dict:
-        return self._post(node, f"/index/{index}/import-values", payload)
+        out = self._post(node, f"/index/{index}/import-values",
+                         self._piggyback(node, payload), op="import")
+        self._apply_gossip(out)
+        return out
 
     def import_roaring_shard(self, node, index: str, shard: int,
                              payload: dict) -> dict:
-        return self._post(
-            node, f"/index/{index}/shard/{shard}/import-roaring", payload)
+        out = self._post(
+            node, f"/index/{index}/shard/{shard}/import-roaring",
+            self._piggyback(node, payload), op="import")
+        self._apply_gossip(out)
+        return out
 
     # -- translation (reference: cluster.go:233-887 key RPC loops) ---------
 
     def create_index_keys(self, node, index: str, keys: List[str]) -> Dict[str, int]:
         out = self._post(node, f"/internal/translate/index/{index}/keys/create",
-                         {"keys": keys})
+                         {"keys": keys}, op="translate")
         return {k: int(v) for k, v in out["ids"].items()}
 
     def find_index_keys(self, node, index: str, keys: List[str]) -> Dict[str, int]:
         out = self._post(node, f"/internal/translate/index/{index}/keys/find",
-                         {"keys": keys})
+                         {"keys": keys}, op="translate")
         return {k: int(v) for k, v in out["ids"].items()}
 
     def translate_index_ids(self, node, index: str, ids: List[int]) -> Dict[int, str]:
         out = self._post(node, f"/internal/translate/index/{index}/ids",
-                         {"ids": list(ids)})
+                         {"ids": list(ids)}, op="translate")
         return {int(k): v for k, v in out["keys"].items()}
 
     def create_field_keys(self, node, index: str, field: str,
                           keys: List[str]) -> Dict[str, int]:
         out = self._post(
             node, f"/internal/translate/field/{index}/{field}/keys/create",
-            {"keys": keys})
+            {"keys": keys}, op="translate")
         return {k: int(v) for k, v in out["ids"].items()}
 
     def find_field_keys(self, node, index: str, field: str,
                         keys: List[str]) -> Dict[str, int]:
         out = self._post(
             node, f"/internal/translate/field/{index}/{field}/keys/find",
-            {"keys": keys})
+            {"keys": keys}, op="translate")
         return {k: int(v) for k, v in out["ids"].items()}
 
     def translate_field_ids(self, node, index: str, field: str,
                             ids: List[int]) -> Dict[int, str]:
         out = self._post(node, f"/internal/translate/field/{index}/{field}/ids",
-                         {"ids": list(ids)})
+                         {"ids": list(ids)}, op="translate")
         return {int(k): v for k, v in out["keys"].items()}
 
     def replicate_translate(self, node, index: str, field: Optional[str],
@@ -188,7 +230,8 @@ class InternalClient:
         translate.go EntryReader / http_translator.go sync stream)."""
         self._post(node, "/internal/translate/replicate",
                    {"index": index, "field": field,
-                    "entries": [[k, int(i)] for k, i in entries]})
+                    "entries": [[k, int(i)] for k, i in entries]},
+                   op="translate")
 
     # -- SQL subtree fanout (reference: /sql-exec-graph,
     #    http_handler.go:538 + sql3/planner/wireprotocol.go) --------------
@@ -197,12 +240,21 @@ class InternalClient:
                     token=None) -> dict:
         return self._post(node, "/internal/sql/subtree",
                           {"spec": spec, "shards": list(shards)},
-                          token=token)
+                          token=token, op="sql")
 
     # -- control plane -----------------------------------------------------
 
     def send_message(self, node, msg: dict) -> None:
-        self._post(node, "/internal/cluster/message", msg)
+        out = self._post(node, "/internal/cluster/message",
+                         self._piggyback(node, msg), op="broadcast")
+        self._apply_gossip(out)
+
+    def gossip_exchange(self, node, payload: dict) -> dict:
+        """Anti-entropy push/pull: POST our envelope, the peer replies
+        with one of its own (applied by GossipAgent.run_round, not here —
+        the agent owns its digest bookkeeping)."""
+        return self._post(node, "/internal/gossip/exchange", payload,
+                          op="gossip")
 
     def status(self, node) -> Optional[dict]:
         """None when the node is unreachable (used as the liveness probe)."""

@@ -64,6 +64,12 @@ class ClusterExecutor:
         # invalidate immediately; other writers are TTL-bounded).
         self.cache = None
         self._write_epoch: Dict[str, int] = {}
+        # optional gossip agent (gossip/), set by ClusterNode.enable_gossip.
+        # When present, remote-leg partials are keyed on the gossiped
+        # version fingerprint instead of TTL+epoch: a write anywhere in
+        # the cluster changes some origin's seq, so entries self-
+        # invalidate exactly with zero TTL reliance.
+        self.gossip = None
         # optional fan-out resilience manager (cluster/resilience.py), set
         # by ClusterNode.enable_resilience: hedged remote legs, per-node
         # circuit breakers, adaptive per-leg timeouts. READ fan-outs only
@@ -216,7 +222,21 @@ class ClusterExecutor:
                                        token=token)[0])
 
         cache = self.cache
-        if cache is not None and cache.ttl_ms > 0:
+        if cache is not None and self.gossip is not None:
+            from pilosa_tpu.cache.keys import shard_key
+            gossip = self.gossip
+
+            def run_remote_gossip(node, s, token=None, _raw=run_remote):
+                # exact invalidation: the gossiped fingerprint covers
+                # every known origin's version slots for these shards,
+                # so a write anywhere (once disseminated) changes the
+                # key and the stale entry simply never matches again
+                key = ("rlegg", idx.name, pql, shard_key(s),
+                       gossip.remote_fingerprint(idx.name, s))
+                return cache.run(key, lambda: _raw(node, s, token))
+
+            run_remote = run_remote_gossip
+        elif cache is not None and cache.ttl_ms > 0:
             from pilosa_tpu.cache.keys import shard_key
 
             def run_remote_cached(node, s, token=None, _raw=run_remote):

@@ -67,6 +67,24 @@ class LocalCluster:
     def coordinator(self) -> ClusterNode:
         return self.nodes[0]
 
+    def enable_gossip(self, **kw) -> list:
+        """Enable gossip on every node (gossip/agent.py kwargs pass
+        through). Returns the agents in node order. Tests usually keep
+        ``start=False`` (the default) and drive rounds by hand."""
+        return [node.enable_gossip(**kw) for node in self.nodes]
+
+    def run_gossip_rounds(self, rounds: int = 1) -> int:
+        """Drive ``rounds`` synchronous anti-entropy rounds across every
+        node (round-robin, node order) — the deterministic stand-in for
+        the background threads. Returns total entries applied."""
+        applied = 0
+        for _ in range(rounds):
+            for node in self.nodes:
+                agent = node.gossip
+                if agent is not None:
+                    applied += agent.run_round()
+        return applied
+
     def pause(self, i: int) -> None:
         """Make node i unreachable (keeps its data, like SIGSTOP on a
         container). The listener closes so peers get connection-refused
@@ -92,6 +110,11 @@ class LocalCluster:
             node.disco.register(node.node)  # resume lease + publish uri
 
     def close(self) -> None:
+        for node in self.nodes:
+            try:
+                node.disable_gossip()
+            except Exception:
+                pass
         for srv in self._servers:
             try:
                 srv.shutdown()

@@ -142,6 +142,11 @@ class ClusterNode:
         idx = self.api.holder.indexes.get(index)
         if idx is None:
             return
+        # before the announced-subset early return: version bumps matter
+        # even when the shard SET is unchanged (the common write case)
+        agent = self.executor.gossip
+        if agent is not None:
+            agent.refresh_index(index)
         shards = idx.shards()
         with self._lock:
             if shards <= self._announced.get(index, set()):
@@ -272,6 +277,10 @@ class ClusterNode:
         from pilosa_tpu.cache import ResultCache
 
         cache = ResultCache.from_config(config, **overrides)
+        if self.executor.gossip is not None and cache.ttl_ms > 0:
+            from pilosa_tpu.gossip import warn_remote_ttl_deprecated
+
+            warn_remote_ttl_deprecated()
         self.executor.cache = cache
         self.executor.local.cache = cache
         return cache
@@ -296,10 +305,93 @@ class ClusterNode:
         overrides.setdefault("on_node_up", self._mark_up)
         res = Resilience.from_config(config, **overrides)
         self.executor.resilience = res
+        self._wire_gossip_resilience()
         return res
 
     def disable_resilience(self) -> None:
         self.executor.resilience = None
+
+    # -- cluster metadata gossip (gossip/) ---------------------------------
+
+    @property
+    def gossip(self):
+        return self.executor.gossip
+
+    def enable_gossip(self, config=None, start: bool = False, **overrides):
+        """Attach a gossip agent: fragment version vectors + health +
+        breaker digests, piggybacked on internode RPCs and exchanged in
+        periodic anti-entropy rounds. Remote-leg cache entries switch
+        to exact fingerprint keying (ClusterExecutor.gossip) and peers'
+        breaker observations pre-warm ours. ``start=True`` launches the
+        background round thread (tests drive run_round directly)."""
+        from pilosa_tpu.gossip import GossipAgent, warn_remote_ttl_deprecated
+
+        self.disable_gossip()
+        peers_fn = lambda: [n for n in self.disco.nodes()
+                            if n.id != self.node.id]
+        agent = GossipAgent.from_config(
+            self.node.id, self.client, peers_fn, self.api.holder,
+            config, **overrides)
+        agent.state.on_breaker = self._apply_remote_breaker
+        self.executor.gossip = agent
+        self.client.gossip = agent if agent.piggyback else None
+        self._wire_gossip_resilience()
+        cache = self.executor.cache
+        if cache is not None and cache.ttl_ms > 0:
+            warn_remote_ttl_deprecated()
+        agent.refresh_local()
+        agent.state.record_health()
+        if start:
+            agent.start()
+        return agent
+
+    def disable_gossip(self) -> None:
+        agent, self.executor.gossip = self.executor.gossip, None
+        self.client.gossip = None
+        listener = getattr(self, "_gossip_listener", None)
+        if listener is not None:
+            res = self.executor.resilience
+            if res is not None:
+                res.breaker.remove_listener(listener)
+            self._gossip_listener = None
+        if agent is not None:
+            agent.stop()
+
+    def _wire_gossip_resilience(self) -> None:
+        """Publish our breaker's LOCAL transitions into gossip — called
+        from both enable_gossip and enable_resilience so order doesn't
+        matter. Remote applies don't notify listeners, so a gossiped
+        state never echoes back out as our own observation."""
+        agent = self.executor.gossip
+        res = self.executor.resilience
+        if agent is None or res is None:
+            return
+        old = getattr(self, "_gossip_listener", None)
+        if old is not None:
+            res.breaker.remove_listener(old)
+
+        def listener(target: str, frm: str, to: str,
+                     _agent=agent) -> None:
+            _agent.record_breaker(target, to)
+
+        res.breaker.add_listener(listener)
+        self._gossip_listener = listener
+
+    def _apply_remote_breaker(self, origin: str, target: str,
+                              state) -> None:
+        """A peer's gossiped breaker observation: pre-warm our breaker
+        for the same target (never for ourselves — we know best whether
+        we are up)."""
+        if target == self.node.id:
+            return
+        res = self.executor.resilience
+        if res is None or not isinstance(state, str):
+            return
+        if res.breaker.apply_remote(target, state):
+            from pilosa_tpu.obs import metrics as M
+
+            res.registry.count(M.METRIC_GOSSIP_BREAKER_PREWARMS,
+                               node=target)
 
     def read_executor(self):
         """SQL read plans run against the cluster executor either way —

@@ -130,13 +130,17 @@ _BREAKER_GAUGE = {BREAKER_CLOSED: 0.0, BREAKER_HALF_OPEN: 1.0,
 
 
 class _BreakerSlot:
-    __slots__ = ("state", "failures", "changed_at", "probe_at")
+    __slots__ = ("state", "failures", "changed_at", "probe_at", "remote")
 
     def __init__(self):
         self.state = BREAKER_CLOSED
         self.failures = 0
         self.changed_at = 0.0
         self.probe_at: Optional[float] = None
+        # True while the current state came from a peer's gossiped
+        # observation rather than our own evidence; local evidence
+        # (record_success/record_failure) always clears it
+        self.remote = False
 
 
 class CircuitBreaker:
@@ -162,6 +166,19 @@ class CircuitBreaker:
         self._on_transition = on_transition
         self._lock = threading.Lock()
         self._slots: Dict[str, _BreakerSlot] = {}
+        # extra observers of LOCAL transitions (gossip publishes these to
+        # peers); not fired for apply_remote, so a gossiped state never
+        # echoes back out as our own observation
+        self._listeners: List[Callable[[str, str, str], None]] = []
+
+    def add_listener(self, fn: Callable[[str, str, str], None]) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, str, str], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def _slot(self, node_id: str) -> _BreakerSlot:
         s = self._slots.get(node_id)
@@ -170,7 +187,7 @@ class CircuitBreaker:
         return s
 
     def _transition(self, node_id: str, slot: _BreakerSlot,
-                    to: str) -> None:
+                    to: str, notify: bool = True) -> None:
         frm = slot.state
         if frm == to:
             return
@@ -182,6 +199,38 @@ class CircuitBreaker:
                             node=node_id, to=to)
         if self._on_transition is not None:
             self._on_transition(node_id, frm, to)
+        if notify:
+            for fn in list(self._listeners):
+                fn(node_id, frm, to)
+
+    def apply_remote(self, node_id: str, state: str) -> bool:
+        """Adopt a peer's gossiped breaker observation. Open/half-open
+        always apply (a peer saw the node fail; pre-warm instead of
+        re-learning the hard way) — adopted as OPEN so OUR open_s
+        countdown gates our own probe. A gossiped close only applies if
+        our current state itself came from gossip: local failure
+        evidence outranks a peer's recovery claim. Listeners are not
+        notified (this is not our observation). Returns True when a
+        transition happened."""
+        if state not in _BREAKER_GAUGE:
+            return False
+        with self._lock:
+            slot = self._slot(node_id)
+            if state in (BREAKER_OPEN, BREAKER_HALF_OPEN):
+                if slot.state != BREAKER_CLOSED:
+                    return False  # already defending; keep our countdown
+                self._transition(node_id, slot, BREAKER_OPEN, notify=False)
+                slot.remote = True
+                slot.probe_at = None
+                return True
+            # state == closed
+            if slot.state == BREAKER_CLOSED or not slot.remote:
+                return False
+            slot.remote = False
+            slot.failures = 0
+            slot.probe_at = None
+            self._transition(node_id, slot, BREAKER_CLOSED, notify=False)
+            return True
 
     def state(self, node_id: str) -> str:
         with self._lock:
@@ -213,12 +262,14 @@ class CircuitBreaker:
             slot = self._slot(node_id)
             slot.failures = 0
             slot.probe_at = None
+            slot.remote = False  # our own evidence from here on
             self._transition(node_id, slot, BREAKER_CLOSED)
 
     def record_failure(self, node_id: str) -> None:
         with self._lock:
             slot = self._slot(node_id)
             slot.probe_at = None
+            slot.remote = False  # our own evidence from here on
             if slot.state == BREAKER_HALF_OPEN:
                 self._transition(node_id, slot, BREAKER_OPEN)
                 return
@@ -237,19 +288,24 @@ class InjectedFault(OSError):
 
 
 class _FaultRule:
-    __slots__ = ("kind", "seconds", "first", "count", "prob", "period")
+    __slots__ = ("kind", "seconds", "first", "count", "prob", "period",
+                 "op")
 
     def __init__(self, kind: str, seconds: float = 0.0, first: int = 0,
                  count: Optional[int] = None, prob: Optional[float] = None,
-                 period: int = 2):
+                 period: int = 2, op: Optional[str] = None):
         self.kind = kind
         self.seconds = seconds
         self.first = first
         self.count = count
         self.prob = prob
         self.period = max(1, int(period))
+        self.op = op
 
-    def matches(self, k: int, rng_hit: Callable[[], float]) -> bool:
+    def matches(self, k: int, rng_hit: Callable[[], float],
+                op: Optional[str] = None) -> bool:
+        if self.op is not None and self.op != op:
+            return False
         if k < self.first:
             return False
         if self.count is not None and k >= self.first + self.count:
@@ -280,9 +336,13 @@ class FaultPlan:
                           ``first`` — an intermittently failing node
 
     Each accepts ``first`` (0-based per-node request index the rule arms
-    at), ``count`` (how many matching indices it stays armed for) and
-    ``prob`` (seeded per-request probability; omitted = always). The
-    seed defaults to ``PILOSA_TPU_FAULT_SEED`` (0 when unset)."""
+    at), ``count`` (how many matching indices it stays armed for),
+    ``prob`` (seeded per-request probability; omitted = always) and
+    ``op`` (scope the rule to one RPC boundary — the client tags
+    "query" / "import" / "translate" / "sql" / "broadcast" / "gossip";
+    omitted = every op). Per-node request indices count ALL ops, so
+    op-scoped rules see the same arrival order the wire does. The seed
+    defaults to ``PILOSA_TPU_FAULT_SEED`` (0 when unset)."""
 
     def __init__(self, seed: Optional[int] = None, sleep=None):
         if seed is None:
@@ -298,23 +358,27 @@ class FaultPlan:
 
     def drop(self, node_id: str, first: int = 0,
              count: Optional[int] = None,
-             prob: Optional[float] = None) -> "FaultPlan":
+             prob: Optional[float] = None,
+             op: Optional[str] = None) -> "FaultPlan":
         self._rules.setdefault(node_id, []).append(
-            _FaultRule("drop", first=first, count=count, prob=prob))
+            _FaultRule("drop", first=first, count=count, prob=prob, op=op))
         return self
 
     def delay(self, node_id: str, seconds: float, first: int = 0,
               count: Optional[int] = None,
-              prob: Optional[float] = None) -> "FaultPlan":
+              prob: Optional[float] = None,
+              op: Optional[str] = None) -> "FaultPlan":
         self._rules.setdefault(node_id, []).append(
             _FaultRule("delay", seconds=seconds, first=first, count=count,
-                       prob=prob))
+                       prob=prob, op=op))
         return self
 
     def flap(self, node_id: str, period: int = 2, first: int = 0,
-             count: Optional[int] = None) -> "FaultPlan":
+             count: Optional[int] = None,
+             op: Optional[str] = None) -> "FaultPlan":
         self._rules.setdefault(node_id, []).append(
-            _FaultRule("flap", first=first, count=count, period=period))
+            _FaultRule("flap", first=first, count=count, period=period,
+                       op=op))
         return self
 
     def seen(self, node_id: str) -> int:
@@ -340,7 +404,8 @@ class FaultPlan:
         return random.Random(f"{self.seed}:{node_id}:{k}").random
 
     def on_request(self, node_id: str,
-                   token: Optional[CancellationToken] = None) -> None:
+                   token: Optional[CancellationToken] = None,
+                   op: Optional[str] = None) -> None:
         with self._lock:
             rules = list(self._rules.get(node_id, ()))
             if not rules:
@@ -348,7 +413,8 @@ class FaultPlan:
             k = self._counts.get(node_id, 0)
             self._counts[node_id] = k + 1
             rule = next(
-                (r for r in rules if r.matches(k, self._hit_rng(node_id, k))),
+                (r for r in rules
+                 if r.matches(k, self._hit_rng(node_id, k), op)),
                 None)
             if rule is not None:
                 self.events.append((node_id, k, rule.kind))

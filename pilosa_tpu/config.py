@@ -119,6 +119,18 @@ class Config:
     cache_max_bytes: int = 64 << 20
     cache_max_entries: int = 4096
     cache_ttl_ms: float = 0.0  # <=0: no TTL (and remote-leg caching off)
+    # cluster metadata gossip ([gossip] section / PILOSA_TPU_GOSSIP_*):
+    # fragment version vectors, health + breaker digests, piggybacked on
+    # internode RPCs with periodic anti-entropy rounds (gossip/; attach
+    # via ClusterNode.enable_gossip). With gossip on, remote-leg cache
+    # entries key on the gossiped fingerprint and cache-ttl-ms is
+    # deprecated for that path.
+    gossip_enabled: bool = False
+    gossip_interval_ms: float = 100.0  # anti-entropy round period
+    gossip_fanout: int = 1  # peers contacted per round
+    gossip_seed: int = 0  # deterministic peer selection seed
+    gossip_max_deltas: int = 512  # entries per envelope (complete windows)
+    gossip_piggyback: bool = True  # ride envelopes on query/import/broadcast
     # fan-out resilience ([cluster.resilience] section /
     # PILOSA_TPU_CLUSTER_RESILIENCE_*): hedged remote shard legs,
     # per-node circuit breakers, adaptive per-leg timeouts

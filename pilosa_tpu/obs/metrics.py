@@ -92,6 +92,26 @@ METRIC_CLUSTER_LEG_LATENCY = "cluster_leg_latency_ms"
 # the upper decades
 LEG_LATENCY_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                           500.0, 1000.0, 2500.0, 5000.0)
+# cluster metadata gossip (gossip/): anti-entropy rounds by outcome
+# (ok / err / idle), delta entries shipped and applied, envelopes that
+# rode existing RPC traffic, per-node state-table gauges, how old an
+# applied delta was when it landed (the convergence/staleness read), and
+# breakers pre-warmed from a peer's observed transitions
+METRIC_GOSSIP_ROUNDS = "gossip_rounds_total"
+METRIC_GOSSIP_DELTAS_SENT = "gossip_deltas_sent_total"
+METRIC_GOSSIP_DELTAS_APPLIED = "gossip_deltas_applied_total"
+METRIC_GOSSIP_PIGGYBACKS = "gossip_piggybacks_total"
+METRIC_GOSSIP_ENTRIES = "gossip_entries"
+METRIC_GOSSIP_ORIGINS = "gossip_known_origins"
+METRIC_GOSSIP_ROUND_MS = "gossip_round_ms"  # histogram
+METRIC_GOSSIP_STALENESS_MS = "gossip_apply_staleness_ms"  # histogram
+METRIC_GOSSIP_BREAKER_PREWARMS = "gossip_breaker_prewarms_total"
+# a loopback anti-entropy round is a couple of HTTP exchanges (~1-10ms);
+# staleness spans one piggyback hop up to several missed rounds
+GOSSIP_ROUND_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                           100.0, 250.0)
+GOSSIP_STALENESS_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                               250.0, 1000.0, 5000.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -114,6 +114,10 @@ _ROUTES = [
     # /internal/* route (auth.py ROUTE_LEVELS falls back to admin)
     ("POST", re.compile(r"^/internal/cache/flush$"), "post_cache_flush"),
     ("GET", re.compile(r"^/internal/cache/stats$"), "get_cache_stats"),
+    # cluster metadata gossip (gossip/): anti-entropy exchange + state
+    ("POST", re.compile(r"^/internal/gossip/exchange$"),
+     "post_gossip_exchange"),
+    ("GET", re.compile(r"^/internal/gossip/state$"), "get_gossip_state"),
     # observability (reference: http_handler.go:495-497, :540)
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
@@ -431,6 +435,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_import(self, index: str):
         b = self._json_body()
+        peer = self._gossip_apply(b)
         n = self.api.import_bits(
             index, self._require(b, "field"),
             rows=b.get("rows", []), cols=b.get("cols", []),
@@ -438,7 +443,7 @@ class Handler(BaseHTTPRequestHandler):
             clear=bool(b.get("clear", False)),
             remote=bool(b.get("remote", False)),
         )
-        self._send(200, {"changed": n})
+        self._send(200, self._gossip_reply(peer, {"changed": n}))
 
     def post_import_roaring(self, index: str, shard: str):
         """Shard-transactional roaring import (reference:
@@ -448,21 +453,23 @@ class Handler(BaseHTTPRequestHandler):
         import base64
 
         b = self._json_body()
+        peer = self._gossip_apply(b)
         views = {v: base64.b64decode(blob)
                  for v, blob in (b.get("views") or {}).items()}
         self.api.import_roaring(index, self._require(b, "field"), int(shard), views,
                                 clear=bool(b.get("clear", False)),
                                 remote=bool(b.get("remote", False)))
-        self._send(200, {"success": True})
+        self._send(200, self._gossip_reply(peer, {"success": True}))
 
     def post_import_values(self, index: str):
         b = self._json_body()
+        peer = self._gossip_apply(b)
         n = self.api.import_values(
             index, self._require(b, "field"), cols=b.get("cols", []),
             values=b.get("values", []), col_keys=b.get("colKeys"),
             remote=bool(b.get("remote", False)),
         )
-        self._send(200, {"imported": n})
+        self._send(200, self._gossip_reply(peer, {"imported": n}))
 
     def get_backup_tar(self):
         import io
@@ -770,6 +777,53 @@ class Handler(BaseHTTPRequestHandler):
         if not hasattr(self.api, "query_remote"):
             raise KeyError("not a cluster node")
 
+    # -- gossip piggybacking (gossip/agent.py) -----------------------------
+
+    def _gossip_apply(self, body):
+        """Apply a piggybacked request envelope BEFORE executing the
+        request; returns the sender's node id (for the reply window) or
+        None. A write's envelope lands first, so the forwarded write's
+        version bumps are visible to the execution below it."""
+        env = body.get("gossip") if isinstance(body, dict) else None
+        agent = getattr(self.api, "gossip", None)
+        if agent is None or not isinstance(env, dict):
+            return None
+        agent.receive(env)
+        return env.get("from")
+
+    def _gossip_reply(self, peer, payload: dict) -> dict:
+        """Attach our envelope to the response AFTER executing — a write
+        handled above already bumped local versions (refresh hooks run
+        inside the import/query paths), so the caller applies our new
+        seqs with zero stale window."""
+        agent = getattr(self.api, "gossip", None)
+        if agent is not None and peer is not None:
+            payload["gossip"] = agent.envelope(peer)
+        return payload
+
+    def post_gossip_exchange(self):
+        self._node_only()
+        agent = getattr(self.api, "gossip", None)
+        if agent is None:
+            self._send(200, {"enabled": False})
+            return
+        b = self._json_body()
+        env = b.get("gossip")
+        peer = None
+        if isinstance(env, dict):
+            agent.receive(env)
+            peer = env.get("from")
+        self._send(200, {"enabled": True,
+                         "gossip": agent.envelope(peer)})
+
+    def get_gossip_state(self):
+        self._node_only()
+        agent = getattr(self.api, "gossip", None)
+        if agent is None:
+            self._send(200, {"enabled": False})
+            return
+        self._send(200, {"enabled": True, **agent.state_json()})
+
     def post_grpc(self, method: str):
         """gRPC method over HTTP/1.1 with standard gRPC message framing
         (server/grpc.py; grpc-status rides a header since HTTP/1.1 lacks
@@ -894,14 +948,17 @@ class Handler(BaseHTTPRequestHandler):
     def post_internal_query(self, index: str):
         self._node_only()
         b = self._json_body()
+        peer = self._gossip_apply(b)
         results = self.api.query_remote(
             index, self._require(b, "query"), b.get("shards") or [])
-        self._send(200, {"results": results})
+        self._send(200, self._gossip_reply(peer, {"results": results}))
 
     def post_cluster_message(self):
         self._node_only()
-        self.api.receive_message(self._json_body())
-        self._send(200, {"success": True})
+        b = self._json_body()
+        peer = self._gossip_apply(b)
+        self.api.receive_message(b)
+        self._send(200, self._gossip_reply(peer, {"success": True}))
 
     # -- resource accounting (reference: http_handler.go:557-559) ----------
 

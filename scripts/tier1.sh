@@ -31,6 +31,16 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== gossip-determinism lane (PILOSA_TPU_GOSSIP_SEED=1 / 7) =="
+# Gossip convergence must hold for ANY peer-selection seed (the seed
+# only steers which peer an anti-entropy round contacts); two fixed
+# seeds exercise two distinct exchange schedules reproducibly.
+for seed in 1 7; do
+    PILOSA_TPU_GOSSIP_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_gossip.py -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

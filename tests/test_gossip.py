@@ -1,0 +1,596 @@
+"""Cluster metadata gossip tests: version-vector state tables, delta
+windows, seeded anti-entropy rounds, piggybacked envelopes, exact
+remote-leg cache invalidation (zero TTL reliance), op-scoped fault
+injection, and breaker-state sharing.
+
+scripts/tier1.sh re-runs this file under two fixed values of
+PILOSA_TPU_GOSSIP_SEED — every test must hold for ANY seed: the seed
+only steers which peer an anti-entropy round contacts, and tests that
+assert exact peer sequences construct their agents with explicit
+seeds."""
+
+import json
+import types
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster import (
+    CircuitBreaker, FaultPlan, GossipAgent, GossipState, InjectedFault,
+    LocalCluster, NodeDownError,
+)
+from pilosa_tpu.cluster.resilience import (
+    BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+)
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.config import Config
+from pilosa_tpu.gossip import _reset_ttl_warning
+from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.obs.metrics import MetricsRegistry
+from pilosa_tpu.sched import ManualClock
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _state(node_id="A", **kw):
+    kw.setdefault("clock", ManualClock())
+    kw.setdefault("registry", MetricsRegistry())
+    return GossipState(node_id, **kw)
+
+
+class TestGossipState:
+    def test_bump_assigns_monotone_seqs_and_dedups_unchanged(self):
+        st = _state()
+        assert st.bump_local(("f", "i", "f1", 0), [1, 5]) is True
+        assert st.bump_local(("f", "i", "f1", 0), [1, 5]) is False  # same
+        assert st.bump_local(("f", "i", "f1", 0), [1, 6]) is True
+        assert st.digest() == {"A": 2}
+        assert len(st) == 1  # re-bump replaces, never accumulates
+
+    def test_deltas_since_windows_and_digest(self):
+        st = _state()
+        for i in range(4):
+            st.bump_local(("f", "i", "f1", i), [1, 1])
+        assert [d["s"] for d in st.deltas_since({})] == [1, 2, 3, 4]
+        assert [d["s"] for d in st.deltas_since({"A": 2})] == [3, 4]
+        assert st.deltas_since({"A": 4}) == []
+
+    def test_cap_truncation_keeps_lowest_seqs(self):
+        # complete-window invariant: a truncated batch must be the LOW
+        # end of the window, so the receiver's digest never advances
+        # past an entry it missed
+        st = _state()
+        for i in range(10):
+            st.bump_local(("f", "i", "f1", i), [1, 1])
+        got = st.deltas_since({}, cap=3)
+        assert [d["s"] for d in got] == [1, 2, 3]
+
+    def test_apply_is_idempotent_and_newest_wins(self):
+        a, b = _state("A"), _state("B")
+        a.bump_local(("h", "A"), "up")
+        deltas = a.deltas_since({})
+        assert b.apply(deltas) == 1
+        assert b.apply(deltas) == 0  # replay: no-op
+        a.bump_local(("h", "A"), "down")
+        newer = a.deltas_since({})
+        assert b.apply(newer + deltas) == 1  # stale entry loses
+        (ent,) = b.entries_json()["A"].values()
+        assert ent["v"] == "down"
+
+    def test_apply_skips_own_origin(self):
+        a = _state("A")
+        echoed = [{"o": "A", "k": ["h", "A"], "v": "up", "s": 9, "t": 0.0}]
+        assert a.apply(echoed) == 0
+        assert a.digest() == {}
+
+    def test_remote_fingerprint_filters_and_tracks_seqs(self):
+        a, b = _state("A"), _state("B")
+        a.bump_local(("f", "i", "f1", 0), [1, 5])
+        a.bump_local(("f", "i", "f1", 3), [1, 5])  # shard outside set
+        a.bump_local(("f", "other", "f1", 0), [1, 5])  # other index
+        b.apply(a.deltas_since({}))
+        b.bump_local(("f", "i", "f1", 0), [1, 7])
+        fp = b.remote_fingerprint("i", [0, 1])
+        assert fp == (("A", "f1", 0, 1), ("B", "f1", 0, 1))
+        before = fp
+        b.apply([{"o": "A", "k": ["f", "i", "f1", 0], "v": [1, 9],
+                  "s": 4, "t": 0.0}])
+        assert b.remote_fingerprint("i", [0, 1]) != before
+
+    def test_refresh_index_tracks_real_writes(self):
+        api = API()
+        api.create_index("ri")
+        api.create_field("ri", "f")
+        st = _state("A")
+        idx = api.holder.indexes["ri"]
+        assert st.refresh_index(idx) == 0  # no fragments yet
+        api.import_bits("ri", "f", rows=[1], cols=[5])
+        assert st.refresh_index(idx) >= 1
+        fp1 = st.remote_fingerprint("ri", [0])
+        assert st.refresh_index(idx) == 0  # no change, no bump
+        api.import_bits("ri", "f", rows=[1], cols=[6])
+        assert st.refresh_index(idx) >= 1
+        assert st.remote_fingerprint("ri", [0]) != fp1
+
+
+def _mknodes(n):
+    return [Node(id=f"node{i}", uri="") for i in range(n)]
+
+
+class _LoopNet:
+    """In-process transport: routes gossip exchanges straight between
+    agents (no HTTP), recording the exchange trace."""
+
+    def __init__(self):
+        self.agents = {}
+        self.trace = []
+
+    def gossip_exchange(self, node, payload):
+        env = payload["gossip"]
+        self.trace.append((env["from"], node.id))
+        peer = self.agents[node.id]
+        peer.receive(env)
+        return {"gossip": peer.envelope(env["from"])}
+
+
+def _mkagents(n, seed=11, clock=None, net=None):
+    net = net or _LoopNet()
+    clock = clock or ManualClock()
+    nodes = _mknodes(n)
+    agents = []
+    for node in nodes:
+        holder = types.SimpleNamespace(indexes={})
+        ag = GossipAgent(
+            node.id, net, lambda nid=node.id: [x for x in nodes
+                                               if x.id != nid],
+            holder, seed=seed, clock=clock, registry=MetricsRegistry())
+        net.agents[node.id] = ag
+        agents.append(ag)
+    return agents, net
+
+
+class TestGossipAgent:
+    def test_roundtrip_then_silent(self):
+        agents, net = _mkagents(2)
+        a, b = agents
+        a.state.bump_local(("h", "node0"), "up")
+        assert a.run_round() == 0  # pushes; B had nothing for us
+        assert b.state.digest() == {"node0": 1}
+        # B now holds and advertises node0@1; next round ships nothing
+        env = a.envelope("node1")
+        assert env["deltas"] == []
+
+    def test_transitive_relay(self):
+        # A -> B -> C without A ever talking to C
+        agents, net = _mkagents(3)
+        a, b, c = agents
+        a.state.bump_local(("h", "node0"), "up")
+        net.trace.clear()
+        b.receive(a.envelope(None))
+        c.receive(b.envelope(None))
+        assert c.state.digest().get("node0") == 1
+
+    def test_seeded_peer_choice_is_deterministic(self):
+        traces = []
+        for _ in range(2):
+            agents, net = _mkagents(4, seed=5)
+            for _ in range(6):
+                for ag in agents:
+                    ag.run_round()
+            traces.append(list(net.trace))
+        assert traces[0] == traces[1]
+        # a different seed picks a different exchange sequence
+        agents, net2 = _mkagents(4, seed=6)
+        for _ in range(6):
+            for ag in agents:
+                ag.run_round()
+        assert net2.trace != traces[0]
+
+    def test_rounds_deterministic_under_manual_clock(self):
+        # full determinism: same seed + ManualClock => byte-identical
+        # final state tables (stamps included)
+        finals = []
+        for _ in range(2):
+            agents, _ = _mkagents(3, seed=9, clock=ManualClock())
+            agents[0].state.bump_local(("f", "i", "f1", 0), [1, 2])
+            agents[1].state.bump_local(("f", "i", "f1", 1), [1, 4])
+            for ag in agents:
+                ag.run_round()
+            finals.append([json.dumps(ag.state_json(), sort_keys=True)
+                           for ag in agents])
+        assert finals[0] == finals[1]
+
+    def test_env_seed_default(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_GOSSIP_SEED", "42")
+        ag = GossipAgent("x", None, lambda: [],
+                         types.SimpleNamespace(indexes={}),
+                         registry=MetricsRegistry())
+        assert ag.seed == 42
+
+    def test_idle_round_without_peers(self):
+        reg = MetricsRegistry()
+        ag = GossipAgent("x", None, lambda: [],
+                         types.SimpleNamespace(indexes={}),
+                         clock=ManualClock(), registry=reg)
+        assert ag.run_round() == 0
+        assert reg.value(M.METRIC_GOSSIP_ROUNDS, outcome="idle") == 1.0
+
+    def test_round_survives_down_peer(self):
+        class _DeadNet:
+            def gossip_exchange(self, node, payload):
+                raise NodeDownError("down")
+
+        reg = MetricsRegistry()
+        ag = GossipAgent("x", _DeadNet(), lambda: _mknodes(2)[1:],
+                         types.SimpleNamespace(indexes={}),
+                         clock=ManualClock(), registry=reg)
+        assert ag.run_round() == 0
+        assert reg.value(M.METRIC_GOSSIP_ROUNDS, outcome="err") == 1.0
+
+    def test_from_config_maps_fields(self):
+        cfg = Config(gossip_interval_ms=7.0, gossip_fanout=2,
+                     gossip_seed=13, gossip_max_deltas=99,
+                     gossip_piggyback=False)
+        ag = GossipAgent.from_config(
+            "x", None, lambda: [], types.SimpleNamespace(indexes={}),
+            cfg, registry=MetricsRegistry())
+        assert (ag.interval_ms, ag.fanout, ag.seed, ag.max_deltas,
+                ag.piggyback) == (7.0, 2, 13, 99, False)
+
+
+class TestFaultPlanOps:
+    def test_op_scoped_rule_only_matches_its_op(self):
+        plan = FaultPlan(seed=1)
+        plan.drop("n1", op="gossip")
+        with pytest.raises(InjectedFault):
+            plan.on_request("n1", op="gossip")
+        plan.on_request("n1", op="query")  # unscoped op passes
+        plan.on_request("n1")  # untagged request passes
+
+    def test_unscoped_rule_still_matches_everything(self):
+        # backward compatibility: pre-op rules and positional calls
+        plan = FaultPlan(seed=1)
+        plan.drop("n1")
+        with pytest.raises(InjectedFault):
+            plan.on_request("n1")
+        with pytest.raises(InjectedFault):
+            plan.on_request("n1", op="gossip")
+
+    def test_op_scoping_at_the_client_boundary(self):
+        # drop gossip exchanges only: queries keep working while the
+        # anti-entropy channel is down
+        plan = FaultPlan(seed=1).drop("node1", op="gossip")
+        c = LocalCluster(2, fault_plan=plan)
+        try:
+            co = c.coordinator
+            co.create_index("fo")
+            co.create_field("fo", "f")
+            cols = list(range(0, 4 * SHARD_WIDTH, SHARD_WIDTH // 2))
+            co.import_bits("fo", "f", rows=[1] * len(cols), cols=cols)
+            c.enable_gossip(registry=MetricsRegistry())
+            n = co.query("fo", "Count(Row(f=1))")[0]
+            assert n == len(cols)
+            ag = co.gossip
+            peer = c[1].node
+            with pytest.raises(NodeDownError):
+                ag.client.gossip_exchange(peer, {"gossip": ag.envelope(None)})
+        finally:
+            c.close()
+
+
+class TestBreakerSharing:
+    def _mk(self):
+        clk = ManualClock()
+        events = []
+        br = CircuitBreaker(threshold=3, open_s=2.0, clock=clk,
+                            registry=MetricsRegistry())
+        br.add_listener(lambda nid, frm, to: events.append((nid, frm, to)))
+        return br, clk, events
+
+    def test_apply_remote_open_prewarm_and_countdown(self):
+        br, clk, events = self._mk()
+        assert br.apply_remote("n2", BREAKER_OPEN) is True
+        assert br.state("n2") == BREAKER_OPEN
+        assert events == []  # remote applies never notify listeners
+        assert br.allow("n2") is False
+        clk.advance(2.5)  # OUR open_s countdown gates OUR probe
+        assert br.allow("n2") is True
+        assert br.state("n2") == BREAKER_HALF_OPEN
+
+    def test_half_open_gossip_adopted_as_open(self):
+        br, clk, _ = self._mk()
+        assert br.apply_remote("n2", BREAKER_HALF_OPEN) is True
+        assert br.state("n2") == BREAKER_OPEN
+
+    def test_remote_close_only_reverts_remote_state(self):
+        br, clk, _ = self._mk()
+        # locally earned open: a peer's recovery claim must not close it
+        for _ in range(3):
+            br.record_failure("n2")
+        assert br.state("n2") == BREAKER_OPEN
+        assert br.apply_remote("n2", BREAKER_CLOSED) is False
+        assert br.state("n2") == BREAKER_OPEN
+        # remote-warmed open: the same peer's close reverts it
+        br.apply_remote("n3", BREAKER_OPEN)
+        assert br.apply_remote("n3", BREAKER_CLOSED) is True
+        assert br.state("n3") == BREAKER_CLOSED
+
+    def test_local_evidence_overrides_remote_warm(self):
+        br, clk, _ = self._mk()
+        br.apply_remote("n2", BREAKER_OPEN)
+        clk.advance(2.5)
+        assert br.allow("n2")  # half-open probe
+        br.record_success("n2")  # our own probe succeeded
+        assert br.state("n2") == BREAKER_CLOSED
+        # now a stale remote close is a no-op (slot is locally owned)
+        assert br.apply_remote("n2", BREAKER_CLOSED) is False
+
+    def test_local_transitions_notify_listeners(self):
+        br, clk, events = self._mk()
+        for _ in range(3):
+            br.record_failure("n2")
+        assert events == [("n2", BREAKER_CLOSED, BREAKER_OPEN)]
+
+    def test_remote_open_when_already_open_is_noop(self):
+        br, clk, _ = self._mk()
+        br.apply_remote("n2", BREAKER_OPEN)
+        t0 = clk.now()
+        clk.advance(1.0)
+        assert br.apply_remote("n2", BREAKER_OPEN) is False  # keep countdown
+
+
+def _fill(cluster, index, n_shards=4, row=3):
+    co = cluster.coordinator
+    co.create_index(index)
+    co.create_field(index, "f")
+    cols = list(range(0, n_shards * SHARD_WIDTH, SHARD_WIDTH // 4))
+    co.import_bits(index, "f", rows=[row] * len(cols), cols=cols)
+    return len(cols)
+
+
+def _owner_with_shards(cluster, index):
+    for node in cluster.nodes[1:]:
+        idx = node.api.holder.indexes.get(index)
+        if idx is not None and idx.shards():
+            return node
+    pytest.skip("placement put no shards on a non-coordinator")
+
+
+class TestClusterGossip:
+    def test_convergence_to_identical_state(self):
+        c = LocalCluster(3)
+        try:
+            _fill(c, "cv")
+            c.enable_gossip(registry=MetricsRegistry())
+            # fanout=1: N-1 sequential full sweeps bound convergence
+            c.run_gossip_rounds(len(c) + 1)
+            digests = [n.gossip.state.digest() for n in c.nodes]
+            assert digests[0] == digests[1] == digests[2]
+            tables = [json.dumps(
+                {o: {k: {kk: vv for kk, vv in e.items() if kk != "t"}
+                     for k, e in tab.items()}
+                 for o, tab in n.gossip.state.entries_json().items()},
+                sort_keys=True) for n in c.nodes]
+            assert tables[0] == tables[1] == tables[2]
+        finally:
+            c.close()
+
+    def test_convergence_under_drops_delays_flaps(self):
+        plan = (FaultPlan(seed=2)
+                .drop("node1", count=6, op="gossip")
+                .delay("node2", 0.005, count=4, op="gossip")
+                .flap("node0", period=3, op="gossip"))
+        c = LocalCluster(3, fault_plan=plan)
+        try:
+            _fill(c, "cf")
+            c.enable_gossip(registry=MetricsRegistry())
+            # drops cost whole exchanges; give the sweep extra rounds
+            c.run_gossip_rounds(3 * len(c))
+            digests = [n.gossip.state.digest() for n in c.nodes]
+            assert digests[0] == digests[1] == digests[2]
+        finally:
+            c.close()
+
+    def test_exact_invalidation_zero_ttl(self):
+        # the acceptance scenario: write on node B (never through the
+        # coordinator), coordinator's cached remote leg invalidates
+        # after convergence, with the TTL knob at 0 the whole time
+        c = LocalCluster(2)
+        try:
+            n = _fill(c, "xi")
+            c.enable_gossip(registry=MetricsRegistry())
+            c.run_gossip_rounds(3)
+            co = c.coordinator
+            cache = co.enable_cache(ttl_ms=0, registry=MetricsRegistry())
+            assert cache.ttl_ms == 0
+            assert co.query("xi", "Count(Row(f=3))")[0] == n
+            assert co.query("xi", "Count(Row(f=3))")[0] == n
+            assert any(k[0] == "rlegg" for k in cache._entries)
+            assert not any(k[0] == "rleg" for k in cache._entries)
+            hits = cache.stats()["hits"]
+            assert hits >= 1  # remote leg served from cache
+            owner = _owner_with_shards(c, "xi")
+            shard = sorted(owner.api.holder.indexes["xi"].shards())[0]
+            owner.api.import_bits("xi", "f", rows=[3],
+                                  cols=[shard * SHARD_WIDTH + 999])
+            owner._announce_shards("xi")
+            c.run_gossip_rounds(3)
+            assert co.query("xi", "Count(Row(f=3))")[0] == n + 1
+        finally:
+            c.close()
+
+    def test_write_through_invalidates_immediately(self):
+        # a coordinator-forwarded write's response envelope carries the
+        # owner's new versions, so the next read is fresh with ZERO
+        # anti-entropy rounds
+        c = LocalCluster(2)
+        try:
+            n = _fill(c, "wt")
+            c.enable_gossip(registry=MetricsRegistry())
+            c.run_gossip_rounds(3)
+            co = c.coordinator
+            co.enable_cache(ttl_ms=0, registry=MetricsRegistry())
+            assert co.query("wt", "Count(Row(f=3))")[0] == n
+            owner = _owner_with_shards(c, "wt")
+            shard = sorted(owner.api.holder.indexes["wt"].shards())[0]
+            co.import_bits("wt", "f", rows=[3],
+                           cols=[shard * SHARD_WIDTH + 999])
+            # no run_gossip_rounds on purpose
+            assert co.query("wt", "Count(Row(f=3))")[0] == n + 1
+        finally:
+            c.close()
+
+    def test_piggyback_spreads_without_rounds(self):
+        c = LocalCluster(2)
+        try:
+            _fill(c, "pb")
+            c.enable_gossip(registry=MetricsRegistry())
+            co = c.coordinator
+            # a single fan-out query piggybacks envelopes both ways
+            co.query("pb", "Count(Row(f=3))")
+            other = c[1]
+            assert co.node.id in other.gossip.state.digest() or \
+                other.node.id in co.gossip.state.digest()
+        finally:
+            c.close()
+
+    def test_breaker_prewarm_across_cluster(self):
+        # node1 ends up open for a target it never failed against
+        c = LocalCluster(2)
+        try:
+            _fill(c, "bp")
+            c.enable_gossip(registry=MetricsRegistry())
+            reg0, reg1 = MetricsRegistry(), MetricsRegistry()
+            res0 = c[0].enable_resilience(registry=reg0)
+            res1 = c[1].enable_resilience(registry=reg1)
+            for _ in range(3):
+                res0.breaker.record_failure("nodeX")
+            assert res0.breaker.state("nodeX") == BREAKER_OPEN
+            c.run_gossip_rounds(3)
+            assert res1.breaker.state("nodeX") == BREAKER_OPEN
+            assert reg1.value(M.METRIC_GOSSIP_BREAKER_PREWARMS,
+                              node="nodeX") >= 1.0
+        finally:
+            c.close()
+
+    def test_prewarm_never_applies_to_self(self):
+        c = LocalCluster(2)
+        try:
+            _fill(c, "ps")
+            c.enable_gossip(registry=MetricsRegistry())
+            res0 = c[0].enable_resilience(registry=MetricsRegistry())
+            res1 = c[1].enable_resilience(registry=MetricsRegistry())
+            # node0 thinks node1 is down; node1 must not open a breaker
+            # for ITSELF off that gossip
+            for _ in range(3):
+                res0.breaker.record_failure("node1")
+            c.run_gossip_rounds(3)
+            assert res1.breaker.state("node1") == BREAKER_CLOSED
+        finally:
+            c.close()
+
+    def test_ttl_deprecation_warns_once(self):
+        _reset_ttl_warning()
+        c = LocalCluster(2)
+        try:
+            c.enable_gossip(registry=MetricsRegistry())
+            with pytest.warns(DeprecationWarning, match="ttl-ms"):
+                c[0].enable_cache(ttl_ms=500, registry=MetricsRegistry())
+            # second enable: warning already spent
+            import warnings as _w
+
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                c[1].enable_cache(ttl_ms=500, registry=MetricsRegistry())
+        finally:
+            _reset_ttl_warning()
+            c.close()
+
+    def test_state_endpoint_over_http(self):
+        c = LocalCluster(2)
+        try:
+            _fill(c, "se")
+            c.enable_gossip(registry=MetricsRegistry())
+            c.run_gossip_rounds(2)
+            uri = c[0].node.uri + "/internal/gossip/state"
+            with urllib.request.urlopen(uri, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["enabled"] is True
+            assert out["node"] == "node0"
+            assert "node0" in out["entries"]
+            assert out["digest"]
+        finally:
+            c.close()
+
+    def test_state_endpoint_reports_disabled(self):
+        c = LocalCluster(1)
+        try:
+            uri = c[0].node.uri + "/internal/gossip/state"
+            with urllib.request.urlopen(uri, timeout=10) as resp:
+                assert json.loads(resp.read()) == {"enabled": False}
+        finally:
+            c.close()
+
+    def test_exchange_endpoint_round_trips(self):
+        c = LocalCluster(2)
+        try:
+            _fill(c, "xe")
+            c.enable_gossip(registry=MetricsRegistry())
+            ag0 = c[0].gossip
+            req = urllib.request.Request(
+                c[1].node.uri + "/internal/gossip/exchange",
+                data=json.dumps({"gossip": ag0.envelope(None)}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["enabled"] is True
+            assert out["gossip"]["from"] == "node1"
+            # the peer now holds our digest
+            assert "node0" in c[1].gossip.state.digest()
+        finally:
+            c.close()
+
+    def test_disable_gossip_detaches_everything(self):
+        c = LocalCluster(2)
+        try:
+            _fill(c, "dg")
+            c.enable_gossip(registry=MetricsRegistry())
+            co = c.coordinator
+            assert co.gossip is not None
+            assert co.client.gossip is not None
+            co.disable_gossip()
+            assert co.gossip is None
+            assert co.client.gossip is None
+            # gossip-off keeps the pre-gossip cache behavior intact
+            cache = co.enable_cache(ttl_ms=0, registry=MetricsRegistry())
+            co.query("dg", "Count(Row(f=3))")
+            assert not any(k[0] in ("rleg", "rlegg")
+                           for k in cache._entries)
+        finally:
+            c.close()
+
+
+class TestGossipMetrics:
+    def test_exposition_contains_gossip_series(self):
+        agents, _ = _mkagents(2, seed=3)
+        a, b = agents
+        a.state.bump_local(("h", "node0"), "up")
+        a.run_round()
+        b.run_round()
+        text = a.registry.prometheus_text()
+        for name in (M.METRIC_GOSSIP_ROUNDS, M.METRIC_GOSSIP_DELTAS_SENT,
+                     M.METRIC_GOSSIP_ENTRIES, M.METRIC_GOSSIP_ROUND_MS):
+            assert name in text, name
+
+    def test_staleness_histogram_observes_applies(self):
+        clk = ManualClock()
+        reg = MetricsRegistry()
+        a = GossipState("A", clock=clk, registry=reg)
+        b = GossipState("B", clock=clk, registry=reg)
+        a.bump_local(("h", "A"), "up")
+        deltas = a.deltas_since({})
+        clk.advance(0.5)  # the delta is 500ms old when it lands
+        b.apply(deltas)
+        h = reg.histogram(M.METRIC_GOSSIP_STALENESS_MS)
+        assert h["count"] == 1
+        assert h["sum"] == pytest.approx(500.0, rel=0.01)
