@@ -743,6 +743,82 @@ def bench_config10(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 11 — crash recovery: WAL replay time + zero-loss vs WAL size
+# ---------------------------------------------------------------------------
+
+def bench_config11(device: str) -> None:
+    """The crash-consistent recovery plane (storage/recovery.py): for
+    growing WAL tail sizes, commit a write stream, sever the holder's
+    file handles WITHOUT flushing python buffers (abandon_holder — the
+    honest crash), reopen, and measure recovery wall time. Every
+    recovered state is asserted bit-identical to the pre-crash checksum
+    (zero loss), and the control is re-ingesting the same stream through
+    the API — the price you'd pay without a WAL. A seeded kill point
+    then exercises the injected-crash path end-to-end against its
+    oracle prefixes."""
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.storage.recovery import (
+        CrashPlan, abandon_holder, crash_workload, oracle_checksums,
+        run_crash_point,
+    )
+
+    rng = np.random.default_rng(11)
+    base = tempfile.mkdtemp(prefix="pilosa-bench-c11-")
+    sizes = []
+    try:
+        for n_commits in (_n(64), _n(256), _n(1024)):
+            path = os.path.join(base, f"wal{n_commits}")
+            api = API(path)
+            api.create_index("r", {"trackExistence": False})
+            api.create_field("r", "f")
+            api.save()  # schema checkpoint: the WAL tail is all data
+            rows = rng.integers(0, 8, size=(n_commits, 32))
+            cols = rng.integers(0, 1 << 20, size=(n_commits, 32))
+            t0 = time.perf_counter()
+            for i in range(n_commits):
+                api.import_bits("r", "f", rows=rows[i].tolist(),
+                                cols=cols[i].tolist())
+            ingest_s = time.perf_counter() - t0
+            want = api.checksum()
+            wal_bytes = api.holder.wal_bytes()
+            api.holder.flush_wals()
+            abandon_holder(api.holder)
+            t0 = time.perf_counter()
+            recovered = API(path)  # replays checkpoint + WAL tail
+            recover_s = time.perf_counter() - t0
+            assert recovered.checksum() == want, \
+                f"recovery lost data at {n_commits} commits"
+            sizes.append((n_commits, wal_bytes, recover_s, ingest_s))
+
+        # injected crash: a seeded kill point must recover to an exact
+        # committed prefix covering everything acked
+        kp = os.path.join(base, "killpoint")
+        batches = crash_workload(n_batches=8, seed=11)
+        oracle = oracle_checksums(kp, batches)
+        res = run_crash_point(kp, CrashPlan.seeded(11), batches,
+                              checkpoint_bytes=1)
+        assert res["checksum"] in oracle
+        assert oracle.index(res["checksum"]) >= res["acked"]
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    n_commits, wal_bytes, recover_s, ingest_s = sizes[-1]
+    per_size = {f"recover_ms_{n}c": r * 1e3 for n, _w, r, _i in sizes}
+    per_size.update({f"wal_kb_{n}c": w / 1024 for n, w, _r, _i in sizes})
+    _emit(f"c11_wal_recovery_{n_commits}commits{SCALED} ({device})",
+          recover_s * 1e3, "ms", ingest_s / recover_s,
+          wal_bytes=wal_bytes,
+          replay_mbps=wal_bytes / max(recover_s, 1e-9) / 1e6,
+          reingest_ms=ingest_s * 1e3,
+          zero_loss_points=len(sizes) + 1,
+          crash_site=(res["fired"][0] if res["fired"] else "none"),
+          **per_size)
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -893,6 +969,7 @@ _CONFIGS = {
     "8": bench_config8,
     "9": bench_config9,
     "10": bench_config10,
+    "11": bench_config11,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -27,8 +27,10 @@ from pilosa_tpu.transaction import TransactionManager
 
 
 class API:
-    def __init__(self, path: Optional[str] = None, wal_sync: str = "batch"):
-        self.holder = Holder(path, wal_sync=wal_sync)
+    def __init__(self, path: Optional[str] = None, wal_sync: str = "batch",
+                 segment_bytes: Optional[int] = None):
+        self.holder = Holder(path, wal_sync=wal_sync,
+                             segment_bytes=segment_bytes)
         self.executor = Executor(self.holder)
         self.txf = TxFactory(self.holder)
         # observability + ops (reference: tracker.go query history,

@@ -242,6 +242,33 @@ class InternalClient:
                           {"spec": spec, "shards": list(shards)},
                           token=token, op="sql")
 
+    # -- recovery log shipping (storage/recovery.py catch-up) --------------
+
+    def recovery_snapshot(self, node, index: str, shard: int,
+                          token=None) -> dict:
+        """One shard's snapshot from a peer: {"npz": b64 savez of
+        export_shard_arrays, "lsn": peer WAL position it covers}. JSON +
+        base64 (not raw octets) so retries/backoff/fault injection all
+        apply unchanged."""
+        from urllib.parse import quote
+
+        return self._get(
+            node, f"/internal/recovery/snapshot?index={quote(index)}"
+                  f"&shard={int(shard)}", token=token, op="recovery")
+
+    def recovery_wal(self, node, index: str, since_lsn: int,
+                     max_bytes: int, token=None) -> dict:
+        """A batch of the peer's WAL tail above ``since_lsn``:
+        {"frames": b64 CRC-framed records, "last_lsn", "more",
+        "floor_lsn": the peer's checkpoint LSN — a fetch below it means
+        the peer pruned and the caller must re-snapshot}."""
+        from urllib.parse import quote
+
+        return self._get(
+            node, f"/internal/recovery/wal?index={quote(index)}"
+                  f"&since={int(since_lsn)}&max_bytes={int(max_bytes)}",
+            token=token, op="recovery")
+
     # -- control plane -----------------------------------------------------
 
     def send_message(self, node, msg: dict) -> None:

@@ -109,6 +109,12 @@ class LocalCluster:
         elif hasattr(node.disco, "register"):
             node.disco.register(node.node)  # resume lease + publish uri
 
+    def __enter__(self) -> "LocalCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def close(self) -> None:
         for node in self.nodes:
             try:

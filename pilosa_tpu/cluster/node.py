@@ -72,6 +72,10 @@ class ClusterNode:
         # Transaction changes sync to peers so an exclusive transaction
         # on any node excludes cluster-wide (reference: server.go:1082).
         self.api.transactions.on_change = self._sync_transaction
+        # Replica catch-up manager (storage/recovery.py), None until
+        # enable_recovery; remote writes landing mid-catch-up queue
+        # through it instead of interleaving with shipped-tail replay.
+        self._recovery = None
 
     # -- topology ----------------------------------------------------------
 
@@ -393,6 +397,27 @@ class ClusterNode:
             res.registry.count(M.METRIC_GOSSIP_BREAKER_PREWARMS,
                                node=target)
 
+    # -- crash recovery + replica catch-up (storage/recovery.py) -----------
+
+    @property
+    def recovery(self):
+        return self._recovery
+
+    def enable_recovery(self, config=None, **overrides):
+        """Attach a RecoveryManager: lag detection against gossiped
+        fragment version vectors, shard snapshot + WAL-tail catch-up
+        from replica peers, write queueing while catching up, and
+        breaker-gated queryability (requires enable_gossip for lag
+        detection and peer gating)."""
+        from pilosa_tpu.storage.recovery import RecoveryManager
+
+        self._recovery = RecoveryManager.from_config(self, config,
+                                                     **overrides)
+        return self._recovery
+
+    def disable_recovery(self) -> None:
+        self._recovery = None
+
     def read_executor(self):
         """SQL read plans run against the cluster executor either way —
         its local legs consult executor.scheduler themselves."""
@@ -438,6 +463,12 @@ class ClusterNode:
                     row_keys=None, col_keys=None, clear: bool = False,
                     remote: bool = False) -> int:
         if remote:
+            rm = self._recovery
+            if rm is not None and rm.defer(
+                    index, lambda: self.import_bits(
+                        index, field, rows=rows, cols=cols, clear=clear,
+                        remote=True)):
+                return 0  # queued: applies after catch-up completes
             n = self.api.import_bits(index, field, rows=rows, cols=cols,
                                      clear=clear)
             self._announce_shards(index)
@@ -469,6 +500,12 @@ class ClusterNode:
     def import_values(self, index: str, field: str, cols=None, values=None,
                       col_keys=None, remote: bool = False) -> int:
         if remote:
+            rm = self._recovery
+            if rm is not None and rm.defer(
+                    index, lambda: self.import_values(
+                        index, field, cols=cols, values=values,
+                        remote=True)):
+                return 0
             n = self.api.import_values(index, field, cols=cols, values=values)
             self._announce_shards(index)
             return n
@@ -517,6 +554,12 @@ class ClusterNode:
                        views: Dict[str, bytes], clear: bool = False,
                        remote: bool = False) -> None:
         if remote:
+            rm = self._recovery
+            if rm is not None and rm.defer(
+                    index, lambda: self.import_roaring(
+                        index, field, shard, views, clear=clear,
+                        remote=True)):
+                return
             self.api.import_roaring(index, field, shard, views, clear=clear)
             self._announce_shards(index)
             return

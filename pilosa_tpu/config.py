@@ -152,6 +152,17 @@ class Config:
     cluster_resilience_timeout_min_ms: float = 50.0
     cluster_resilience_timeout_max_ms: float = 30000.0
     cluster_resilience_latency_window: int = 64  # rolling samples per node
+    # crash recovery plane ([storage.recovery] section /
+    # PILOSA_TPU_STORAGE_RECOVERY_*): segmented WAL + fuzzy checkpoints +
+    # replica catch-up by log shipping (storage/recovery.py; attach
+    # catch-up via ClusterNode.enable_recovery)
+    # WAL segment rotation size; checkpoints prune whole sealed segments
+    storage_recovery_segment_bytes: int = 4 << 20
+    # record bytes that trigger an automatic fuzzy checkpoint; 0 falls
+    # back to the legacy checkpoint-bytes knob
+    storage_recovery_checkpoint_interval_bytes: int = 0
+    # max shipped WAL-tail bytes per catch-up fetch
+    storage_recovery_catchup_batch_bytes: int = 1 << 20
 
     # -- sources -----------------------------------------------------------
 

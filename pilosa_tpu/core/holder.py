@@ -18,17 +18,26 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 class Holder:
     def __init__(self, path: Optional[str] = None, wal_sync: str = "batch",
-                 checkpoint_bytes: int = 64 << 20, readonly: bool = False):
+                 checkpoint_bytes: int = 64 << 20, readonly: bool = False,
+                 segment_bytes: Optional[int] = None):
         self.path = path
         self.wal_sync = wal_sync
         # readonly: open for a snapshot-only read pass (restore/inspect) —
         # no WAL handles are created and recover() refuses to replay logs
         # (a foreign wal.log is untrusted input; see API.restore_tar).
         self.readonly = readonly
-        # WAL size that triggers an automatic checkpoint (snapshot +
-        # truncate) — the analog of RBF's MaxWALCheckpointSize
-        # (rbf/cfg/cfg.go:10-13).
+        # WAL record volume that triggers an automatic fuzzy checkpoint
+        # (snapshot + segment prune) — the analog of RBF's
+        # MaxWALCheckpointSize (rbf/cfg/cfg.go:10-13).
         self.checkpoint_bytes = checkpoint_bytes
+        # WAL segment rotation size (constructor param because WALs are
+        # opened during _load_schema below).
+        from pilosa_tpu.storage.wal import DEFAULT_SEGMENT_BYTES
+
+        self.segment_bytes = segment_bytes or DEFAULT_SEGMENT_BYTES
+        # storage/recovery.CrashPlan for deterministic kill-point tests;
+        # attach via recovery.attach_crash_plan so existing WALs get it.
+        self.crash_plan = None
         # Serializes write requests against each other and against
         # checkpoints (Qcx holds it for the request; reference: RBF's
         # single-writer tx lock). Reads never take it — they see
@@ -89,7 +98,8 @@ class Holder:
             from pilosa_tpu.storage.wal import WAL
 
             wal = WAL(os.path.join(self._index_path(name), "wal.log"),
-                      sync=self.wal_sync)
+                      sync=self.wal_sync, segment_bytes=self.segment_bytes,
+                      crash_plan=self.crash_plan)
         idx = Index(name, options, path=self._index_path(name), wal=wal,
                     lock=self.write_lock)
         self.indexes[name] = idx
@@ -137,24 +147,64 @@ class Holder:
                 idx.wal.flush()
 
     def wal_bytes(self) -> int:
-        return sum(idx.wal.size for idx in self.indexes.values()
+        """Record bytes pending checkpoint (segment markers excluded —
+        a freshly checkpointed holder reports 0)."""
+        return sum(idx.wal.record_bytes for idx in self.indexes.values()
                    if idx.wal is not None)
 
+    def last_lsn(self) -> int:
+        """The holder-wide commit position: max LSN assigned across all
+        index WALs (each index has its own log, but LSNs only ever
+        grow, so the max orders any two holder states)."""
+        return max((idx.wal.last_lsn for idx in self.indexes.values()
+                    if idx.wal is not None), default=0)
+
     def checkpoint(self) -> None:
-        """Persist all planes, then drop the WAL records they subsume
-        (reference: rbf checkpoint copying WAL pages into the DB file).
-        Takes the write lock so a concurrent writer can't append records
-        between the snapshot and the truncate (RLock: a no-op when called
-        from inside the owning Qcx)."""
-        if not self.path:
+        """Fuzzy checkpoint: flush, capture each index's LSN, snapshot
+        all planes, stamp ``checkpoint.json`` with the LSN, then prune
+        segments wholly below it (reference: rbf checkpoint copying WAL
+        pages into the DB file). A crash between ANY two steps is safe:
+        before the meta write, recovery replays from the old LSN over
+        mixed old/new npz files (every WAL op is plane-idempotent);
+        after it, the snapshot already covers everything the meta
+        claims, and stale segments fall to the next prune. Takes the
+        write lock so a concurrent writer can't append between snapshot
+        and stamp (RLock: a no-op when called from inside the owning
+        Qcx)."""
+        if not self.path or self.readonly:
             return
+        import time
+
+        from pilosa_tpu.obs import metrics as M
+        from pilosa_tpu.storage.recovery import (
+            crash_scope, write_checkpoint_meta,
+        )
         from pilosa_tpu.storage.store import save_holder_data
 
+        plan = self.crash_plan
+        if plan is not None and plan.dead:
+            return
+        t0 = time.perf_counter()
+        pruned = 0
         with self.write_lock:
-            save_holder_data(self)
-            for idx in self.indexes.values():
-                if idx.wal is not None:
-                    idx.wal.truncate()
+            self.flush_wals()
+            lsns = {name: idx.wal.last_lsn
+                    for name, idx in self.indexes.items()
+                    if idx.wal is not None}
+            with crash_scope(plan):
+                save_holder_data(self)
+                if plan is not None and not plan.fire("checkpoint.mid"):
+                    return
+                for name, lsn in lsns.items():
+                    write_checkpoint_meta(self._index_path(name), lsn)
+            for name, lsn in lsns.items():
+                idx = self.indexes.get(name)
+                if idx is not None and idx.wal is not None:
+                    pruned += idx.wal.prune(lsn)
+        M.REGISTRY.observe(M.METRIC_RECOVERY_CHECKPOINT_SECONDS,
+                           time.perf_counter() - t0)
+        if pruned:
+            M.REGISTRY.count(M.METRIC_RECOVERY_SEGMENTS_PRUNED, pruned)
 
     def maybe_checkpoint(self) -> bool:
         if self.path and self.wal_bytes() > self.checkpoint_bytes:
@@ -162,31 +212,59 @@ class Holder:
             return True
         return False
 
-    def recover(self) -> None:
-        """Crash recovery: load the last checkpoint, then replay each
-        index's WAL through the same field-level write methods that
-        produced the records (reference: rbf/db.go WAL replay on open;
-        op-level like dax/storage snapshot+log resume)."""
-        from pilosa_tpu.storage.store import load_holder_data
-
+    def replay_records(self, idx: Index, records) -> int:
+        """Apply an iterable of WAL record tuples to ``idx`` with
+        re-logging suppressed — shared by crash recovery and replica
+        catch-up (which feeds it shipped, shard-filtered tails). A bad
+        record is skipped with a warning, never a brick. Returns records
+        applied."""
         import logging
 
+        wal = idx.wal
+        prev = wal.replaying if wal is not None else False
+        if wal is not None:
+            wal.replaying = True
+        applied = 0
+        try:
+            for rec in records:
+                try:
+                    self._apply_wal_record(idx, rec)
+                    applied += 1
+                except (ValueError, KeyError) as e:
+                    logging.getLogger(__name__).warning(
+                        "skipping unreplayable WAL record %r: %s",
+                        rec[:2], e)
+        finally:
+            if wal is not None:
+                wal.replaying = prev
+        return applied
+
+    def recover(self) -> None:
+        """Crash recovery: load the last checkpoint, then replay each
+        index's WAL records ABOVE its checkpoint LSN through the same
+        field-level write methods that produced them (reference:
+        rbf/db.go WAL replay on open; op-level like dax/storage
+        snapshot+log resume)."""
+        from pilosa_tpu.obs import metrics as M
+        from pilosa_tpu.storage.recovery import read_checkpoint_meta
+        from pilosa_tpu.storage.store import load_holder_data
+
         load_holder_data(self)
-        for idx in self.indexes.values():
+        for name, idx in self.indexes.items():
             if idx.wal is None:
                 continue
-            idx.wal.replaying = True
-            try:
-                for rec in idx.wal.records():
-                    try:
-                        self._apply_wal_record(idx, rec)
-                    except (ValueError, KeyError) as e:
-                        # a bad record must not brick every future open
-                        logging.getLogger(__name__).warning(
-                            "skipping unreplayable WAL record %r: %s",
-                            rec[:2], e)
-            finally:
-                idx.wal.replaying = False
+            ckpt = read_checkpoint_meta(self._index_path(name))
+            nbytes = [0]
+
+            def _tail(w=idx.wal, after=ckpt, nb=nbytes):
+                for _lsn, rec, frame_len in w.replay(after):
+                    nb[0] += frame_len
+                    yield rec
+
+            applied = self.replay_records(idx, _tail())
+            if applied:
+                M.REGISTRY.count(M.METRIC_RECOVERY_REPLAY_RECORDS, applied)
+                M.REGISTRY.count(M.METRIC_RECOVERY_REPLAY_BYTES, nbytes[0])
             # chop any torn tail so post-recovery appends are readable
             idx.wal.repair()
 

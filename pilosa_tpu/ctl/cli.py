@@ -39,8 +39,13 @@ def cmd_server(args) -> int:
     from pilosa_tpu.obs.logger import configure as configure_logging
 
     configure_logging(cfg.log_level, cfg.log_path or None)
-    api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync)
-    api.holder.checkpoint_bytes = cfg.checkpoint_bytes
+    api = API(cfg.data_dir or None, wal_sync=cfg.wal_sync,
+              segment_bytes=cfg.storage_recovery_segment_bytes)
+    # [storage.recovery] checkpoint interval wins when set; the legacy
+    # top-level checkpoint-bytes knob stays the fallback
+    api.holder.checkpoint_bytes = (
+        cfg.storage_recovery_checkpoint_interval_bytes
+        or cfg.checkpoint_bytes)
     if cfg.scheduler_enabled:
         api.enable_scheduler(cfg)
     if cfg.cache_enabled:

@@ -49,6 +49,35 @@ KIND_HEALTH = "h"
 DF_FIELD = "@dataframe"
 
 
+def local_fragment_slots(idx) -> Dict[Tuple[str, int], List[int]]:
+    """(field, shard) -> ``[fragment_count, version_sum]`` for one holder
+    index — the raw material of :meth:`GossipState.refresh_index` and the
+    LOCAL side of replica catch-up lag detection (storage/recovery.py
+    compares these against peers' gossiped slots)."""
+    slots: Dict[Tuple[str, int], List[int]] = {}
+    # list() snapshots: concurrent imports mutate these dicts (same
+    # pattern as server/http.py get_mem_usage)
+    for fname in sorted(list(idx.fields)):
+        field = idx.fields.get(fname)
+        if field is None:
+            continue
+        for view in sorted(list(field.views)):
+            frags = field.views.get(view) or {}
+            for shard, frag in sorted(list(frags.items())):
+                s = slots.setdefault((fname, int(shard)), [0, 0])
+                s[0] += 1
+                s[1] += int(frag.version)
+        for shard, frag in sorted(list(field.bsi.items())):
+            s = slots.setdefault((fname, int(shard)), [0, 0])
+            s[0] += 1
+            s[1] += int(frag.version)
+    for shard, frame in sorted(list(idx.dataframe.frames.items())):
+        s = slots.setdefault((DF_FIELD, int(shard)), [0, 0])
+        s[0] += 1
+        s[1] += int(frame.version)
+    return slots
+
+
 class _Entry:
     __slots__ = ("value", "seq", "stamp")
 
@@ -105,30 +134,8 @@ class GossipState:
         bumps the sum, a new fragment bumps the count, so either changes
         the published value (and hence every covering fingerprint).
         Returns how many slots were bumped."""
-        slots: Dict[Tuple, List[int]] = {}
-        # list() snapshots: concurrent imports mutate these dicts (same
-        # pattern as server/http.py get_mem_usage)
-        for fname in sorted(list(idx.fields)):
-            field = idx.fields.get(fname)
-            if field is None:
-                continue
-            for view in sorted(list(field.views)):
-                frags = field.views.get(view) or {}
-                for shard, frag in sorted(list(frags.items())):
-                    s = slots.setdefault(
-                        (KIND_FRAGMENT, idx.name, fname, int(shard)), [0, 0])
-                    s[0] += 1
-                    s[1] += int(frag.version)
-            for shard, frag in sorted(list(field.bsi.items())):
-                s = slots.setdefault(
-                    (KIND_FRAGMENT, idx.name, fname, int(shard)), [0, 0])
-                s[0] += 1
-                s[1] += int(frag.version)
-        for shard, frame in sorted(list(idx.dataframe.frames.items())):
-            s = slots.setdefault(
-                (KIND_FRAGMENT, idx.name, DF_FIELD, int(shard)), [0, 0])
-            s[0] += 1
-            s[1] += int(frame.version)
+        slots = {(KIND_FRAGMENT, idx.name, fname, shard): v
+                 for (fname, shard), v in local_fragment_slots(idx).items()}
         bumped = 0
         for key in sorted(slots):
             if self.bump_local(key, slots[key]):
@@ -221,6 +228,21 @@ class GossipState:
                         parts.append((origin, key[2], key[3], e.seq))
         parts.sort()
         return tuple(parts)
+
+    def fragment_entries(self, index: str) -> Dict[str, Dict[Tuple, Any]]:
+        """{origin: {(field, shard): [count, version_sum]}} for every
+        NON-SELF origin's fragment slots covering ``index`` — the remote
+        side of replica catch-up lag detection (storage/recovery.py)."""
+        out: Dict[str, Dict[Tuple, Any]] = {}
+        with self._lock:
+            for origin in sorted(self._entries):
+                if origin == self.node_id:
+                    continue
+                for key, e in self._entries[origin].items():
+                    if key[0] == KIND_FRAGMENT and key[1] == index:
+                        out.setdefault(origin, {})[
+                            (key[2], int(key[3]))] = e.value
+        return out
 
     # -- introspection -----------------------------------------------------
 

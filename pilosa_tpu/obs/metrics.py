@@ -112,6 +112,22 @@ GOSSIP_ROUND_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                            100.0, 250.0)
 GOSSIP_STALENESS_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
                                250.0, 1000.0, 5000.0)
+# crash-consistent recovery plane (storage/recovery.py): WAL records and
+# bytes replayed on open or during catch-up, fuzzy-checkpoint duration
+# (summary), segments pruned below the checkpoint LSN, shards repaired
+# by snapshot+tail shipping, writes queued while a node caught up, and
+# the wall-clock lag of each catch-up run
+METRIC_RECOVERY_REPLAY_RECORDS = "recovery_replay_records_total"
+METRIC_RECOVERY_REPLAY_BYTES = "recovery_replay_bytes_total"
+METRIC_RECOVERY_CHECKPOINT_SECONDS = "recovery_checkpoint_seconds"
+METRIC_RECOVERY_SEGMENTS_PRUNED = "recovery_wal_segments_pruned_total"
+METRIC_RECOVERY_CATCHUP_SHARDS = "recovery_catchup_shards_total"
+METRIC_RECOVERY_CATCHUP_QUEUED = "recovery_catchup_queued_writes_total"
+METRIC_RECOVERY_CATCHUP_LAG_MS = "recovery_catchup_lag_ms"  # histogram
+# a loopback snapshot+tail round trip is a few ms; WAN catch-up of a
+# fat tail spans seconds
+RECOVERY_CATCHUP_LAG_BUCKETS_MS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                                   1000.0, 5000.0, 30000.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -22,6 +22,7 @@ engine process.
 
 from __future__ import annotations
 
+import base64
 import json
 import re
 import threading
@@ -118,6 +119,11 @@ _ROUTES = [
     ("POST", re.compile(r"^/internal/gossip/exchange$"),
      "post_gossip_exchange"),
     ("GET", re.compile(r"^/internal/gossip/state$"), "get_gossip_state"),
+    # replica catch-up log shipping (storage/recovery.py): shard
+    # snapshot + WAL tail, JSON+base64 like every internal route
+    ("GET", re.compile(r"^/internal/recovery/snapshot$"),
+     "get_recovery_snapshot"),
+    ("GET", re.compile(r"^/internal/recovery/wal$"), "get_recovery_wal"),
     # observability (reference: http_handler.go:495-497, :540)
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
@@ -823,6 +829,65 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, {"enabled": False})
             return
         self._send(200, {"enabled": True, **agent.state_json()})
+
+    def get_recovery_snapshot(self):
+        """One shard's snapshot + the WAL LSN it covers, for replica
+        catch-up (storage/recovery.py). Taken under the write lock so
+        planes and LSN agree exactly: every record <= lsn is in the
+        arrays, every record > lsn is in the shipped tail."""
+        import io as _io
+        from urllib.parse import parse_qs, urlsplit
+
+        import numpy as _np
+
+        from pilosa_tpu.storage.store import export_shard_arrays
+
+        self._node_only()
+        qs = parse_qs(urlsplit(self.path).query)
+        index = qs.get("index", [""])[0]
+        shard = int(qs.get("shard", ["0"])[0])
+        holder = self.api.holder
+        idx = holder.index(index)
+        with holder.write_lock:
+            if idx.wal is not None:
+                idx.wal.flush()
+            arrays = export_shard_arrays(idx, shard)
+            lsn = idx.wal.last_lsn if idx.wal is not None else 0
+        buf = _io.BytesIO()
+        if arrays:
+            _np.savez_compressed(buf, **arrays)
+        self._send(200, {
+            "index": index, "shard": shard, "lsn": lsn,
+            "npz": base64.b64encode(buf.getvalue()).decode()
+            if arrays else "",
+        })
+
+    def get_recovery_wal(self):
+        """A batch of this node's WAL tail above ``since`` as raw CRC
+        frames (wal.tail_bytes). ``floor_lsn`` is the checkpoint LSN:
+        a caller whose ``since`` is below it raced a prune and must
+        re-snapshot before trusting the tail."""
+        from urllib.parse import parse_qs, urlsplit
+
+        from pilosa_tpu.storage.recovery import read_checkpoint_meta
+
+        self._node_only()
+        qs = parse_qs(urlsplit(self.path).query)
+        index = qs.get("index", [""])[0]
+        since = int(qs.get("since", ["0"])[0])
+        max_bytes = int(qs.get("max_bytes", [str(1 << 20)])[0])
+        holder = self.api.holder
+        idx = holder.index(index)
+        if idx.wal is None:
+            self._send(200, {"frames": "", "last_lsn": since,
+                             "more": False, "floor_lsn": 0})
+            return
+        floor = read_checkpoint_meta(holder._index_path(index))
+        frames, last, more = idx.wal.tail_bytes(since, max_bytes)
+        self._send(200, {
+            "frames": base64.b64encode(frames).decode(),
+            "last_lsn": last, "more": more, "floor_lsn": floor,
+        })
 
     def post_grpc(self, method: str):
         """gRPC method over HTTP/1.1 with standard gRPC message framing

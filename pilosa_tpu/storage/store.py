@@ -170,11 +170,30 @@ def _dump_translate(key_to_id, path: str) -> None:
 
 
 def _atomic_savez(path: str, **arrays) -> None:
+    """tmp + fsync + rename + dir-fsync: the snapshot survives power
+    loss, not just process death (rename alone only orders metadata on
+    some filesystems). Kill sites bracket the rename — the atomicity
+    claim under test is exactly "crash on either side leaves a complete
+    old or complete new file" (storage/recovery.py CrashPlan; the plan
+    arrives thread-locally because array names own the kwargs)."""
+    from pilosa_tpu.storage.recovery import scoped_plan
+    from pilosa_tpu.storage.wal import fsync_dir
+
+    plan = scoped_plan()
+    if plan is not None and plan.dead:
+        return
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    if plan is not None and not plan.fire("savez.pre_replace"):
+        return
     os.replace(tmp, path)
+    if plan is not None and not plan.fire("savez.post_replace"):
+        return
+    fsync_dir(os.path.dirname(path))
 
 
 def export_shard_arrays(idx, shard: int) -> dict:

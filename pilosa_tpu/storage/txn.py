@@ -46,6 +46,9 @@ class Qcx:
     def __init__(self, holder: "Holder"):
         self.holder = holder
         self._done = False
+        # LSN of the last record this commit made durable (set by
+        # finish; 0 for path-less holders / read-only requests).
+        self.lsn = 0
         # Exclude concurrent writers AND checkpoints for the request: a
         # checkpoint racing a half-applied multi-call write would snapshot
         # and truncate records it never persisted. RLock so nested Qcx
@@ -53,16 +56,21 @@ class Qcx:
         self.holder.write_lock.acquire()
         _WRITE_CTX.depth = getattr(_WRITE_CTX, "depth", 0) + 1
 
-    def finish(self) -> None:
+    def finish(self) -> int:
+        """Group commit. Returns the commit LSN: every WAL record up to
+        it is flushed (and fsynced per the sync mode) — the monotonic
+        position checkpoints stamp and catch-up ships against."""
         if self._done:
-            return
+            return self.lsn
         self._done = True
         try:
             self.holder.flush_wals()
+            self.lsn = self.holder.last_lsn()
             self.holder.maybe_checkpoint()
         finally:
             _WRITE_CTX.depth -= 1
             self.holder.write_lock.release()
+        return self.lsn
 
     def __enter__(self) -> "Qcx":
         return self

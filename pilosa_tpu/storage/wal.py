@@ -8,10 +8,25 @@ operations between checkpoints and recovery replays them through the same
 field-level write methods that produced them (deterministic; the analog of
 DAX's op-level writelogger, dax/writelogger/writelogger.go:22).
 
-Framing per record: ``<u32 crc32 of payload><u32 payload len><payload>``,
-payload = pickle of a plain tuple (host-trusted file, like any DB's WAL).
-A torn tail (crash mid-append) fails the CRC/length check and replay stops
-there — everything before it is intact, matching WAL semantics.
+The log is SEGMENTED: records land in numbered files
+``<base>.00000001``, ``<base>.00000002``, ... and the writer rotates to a
+fresh segment once the active one passes ``segment_bytes``. Every record
+carries a monotonic LSN, so a checkpoint stamped with LSN ``L`` can prune
+exactly the segments whose records are all <= L and leave the tail for
+replay (or for shipping to a lagging replica — storage/recovery.py). The
+LSN counter never resets, not even across truncate(), so any two states
+of one holder are ordered by it.
+
+Framing per record: ``<u32 crc32(lsn||payload)><u32 payload len><u64 lsn>``
+followed by the payload — pickle of a plain tuple (host-trusted file,
+like any DB's WAL). A zero-length payload whose CRC checks out is a
+*marker* (each segment opens with one carrying the base LSN — the last
+LSN assigned before the segment existed); replay skips it and keeps
+going. A short header or a CRC/length mismatch is a torn tail (crash
+mid-append) and replay stops there — everything before it is intact,
+matching WAL semantics. The two cases used to be conflated ("stop" for
+both), which would have dropped everything after a legitimate empty
+record; now only genuine tears stop the scan.
 
 Sync modes (reference: rbf cfg fsync knobs, rbf/cfg/cfg.go):
 - "batch" (default): buffered appends, fsync once per flush() — the group
@@ -24,12 +39,70 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
 import struct
 import threading
 import zlib
-from typing import Iterator, Tuple
+from typing import Iterator, List, Optional, Tuple
 
-_HDR = struct.Struct("<II")
+# crc32 over (lsn bytes || payload), payload length, lsn
+_HDR = struct.Struct("<IIQ")
+_LSN = struct.Struct("<Q")
+_SEG_RE = re.compile(r"\.(\d{8})$")
+
+DEFAULT_SEGMENT_BYTES = 4 << 20
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates/unlinks inside it survive
+    power loss, not just process death (the missing half of the classic
+    tmp+rename pattern)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _scan_segment(path: str) -> Tuple[int, int, int, bool]:
+    """Walk one segment's frames: (valid bytes, record bytes excluding
+    markers, max lsn seen, torn?). Stops at the first torn/corrupt
+    frame; bytes behind a tear are unreachable garbage."""
+    valid = rec_bytes = max_lsn = 0
+    torn = False
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                torn = len(hdr) > 0  # short header = tear; EOF = clean
+                break
+            crc, n, lsn = _HDR.unpack(hdr)
+            payload = f.read(n)
+            if len(payload) < n or \
+                    zlib.crc32(_LSN.pack(lsn) + payload) != crc:
+                torn = True
+                break
+            valid += _HDR.size + n
+            if n:  # n == 0 is a valid marker, not a torn header
+                rec_bytes += _HDR.size + n
+            max_lsn = max(max_lsn, lsn)
+    return valid, rec_bytes, max_lsn, torn
+
+
+class _Segment:
+    __slots__ = ("seq", "path", "record_bytes", "max_lsn")
+
+    def __init__(self, seq: int, path: str, record_bytes: int = 0,
+                 max_lsn: int = 0):
+        self.seq = seq
+        self.path = path
+        self.record_bytes = record_bytes
+        self.max_lsn = max_lsn
 
 
 class WAL:
@@ -38,29 +111,115 @@ class WAL:
     mutation holds the instance lock (the reference serializes through
     RBF's single-writer tx lock instead, rbf/db.go)."""
 
-    def __init__(self, path: str, sync: str = "batch"):
+    def __init__(self, path: str, sync: str = "batch",
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 crash_plan=None):
         if sync not in ("always", "batch", "never"):
             raise ValueError(f"bad sync mode {sync!r}")
-        self.path = path
+        self.base = path
         self.sync = sync
+        self.segment_bytes = max(1, int(segment_bytes))
         self.replaying = False  # when True, writers must not re-log
+        # storage/recovery.CrashPlan (or None): consulted at the
+        # wal.append / wal.flush kill sites; once it has fired, this
+        # "process" is dead and every hooked operation silently no-ops.
+        self.crash_plan = crash_plan
         self._lock = threading.Lock()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        self._f = open(path, "ab")
+        self._dir = os.path.dirname(path)
+        os.makedirs(self._dir, exist_ok=True)
+        self._lsn = 0
+        self._segments: List[_Segment] = []
         self._dirty = False
+        self._open_existing()
+
+    # -- open / segments -----------------------------------------------------
+
+    def _open_existing(self) -> None:
+        base_name = os.path.basename(self.base)
+        seqs = []
+        for name in os.listdir(self._dir):
+            if not name.startswith(base_name + "."):
+                continue
+            m = _SEG_RE.search(name)
+            if m:
+                seqs.append(int(m.group(1)))
+        seqs.sort()
+        if os.path.isfile(self.base):
+            # adopt a pre-segmentation single-file log as the next segment
+            seq = (seqs[-1] + 1) if seqs else 1
+            os.rename(self.base, self._seg_path(seq))
+            fsync_dir(self._dir)
+            seqs.append(seq)
+        for seq in seqs:
+            p = self._seg_path(seq)
+            _valid, rec_bytes, max_lsn, _torn = _scan_segment(p)
+            self._segments.append(_Segment(seq, p, rec_bytes, max_lsn))
+            self._lsn = max(self._lsn, max_lsn)
+        if self._segments:
+            self._f = open(self._segments[-1].path, "ab")
+        else:
+            self._new_segment_locked(1)
+
+    def _seg_path(self, seq: int) -> str:
+        return f"{self.base}.{seq:08d}"
+
+    def _new_segment_locked(self, seq: int) -> None:
+        """Create + activate segment ``seq``, stamped with a marker frame
+        carrying the base LSN (the last LSN assigned before this segment
+        existed — the prune boundary for everything before it)."""
+        path = self._seg_path(seq)
+        f = open(path, "wb")
+        f.write(_HDR.pack(zlib.crc32(_LSN.pack(self._lsn)), 0, self._lsn))
+        f.flush()
+        if self.sync != "never":
+            os.fsync(f.fileno())
+        fsync_dir(self._dir)
+        self._segments.append(_Segment(seq, path))
+        self._f = f
+
+    def _rotate_locked(self) -> None:
+        self._flush_locked()
+        if self.sync == "never":  # make the sealed tail readable
+            self._f.flush()
+        self._f.close()
+        self._new_segment_locked(self._segments[-1].seq + 1)
+
+    @property
+    def path(self) -> str:
+        """The ACTIVE segment's path (tests and tooling poke bytes at the
+        write frontier; sealed segments are immutable)."""
+        return self._segments[-1].path
+
+    @property
+    def last_lsn(self) -> int:
+        return self._lsn
 
     # -- write side ----------------------------------------------------------
 
-    def append(self, record: Tuple) -> None:
+    def append(self, record: Tuple) -> Optional[int]:
+        """Append one record; returns its LSN (None when replaying or
+        when the simulated process is dead)."""
         if self.replaying:
-            return
-        payload = pickle.dumps(record, protocol=5)
-        framed = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+            return None
+        plan = self.crash_plan
+        if plan is not None and not plan.fire("wal.append"):
+            return None
         with self._lock:
+            lsn = self._lsn + 1
+            payload = pickle.dumps(record, protocol=5)
+            framed = _HDR.pack(zlib.crc32(_LSN.pack(lsn) + payload),
+                               len(payload), lsn) + payload
             self._f.write(framed)  # one write: no interleaved half-records
+            self._lsn = lsn
+            seg = self._segments[-1]
+            seg.record_bytes += len(framed)
+            seg.max_lsn = lsn
             self._dirty = True
             if self.sync == "always":
                 self._flush_locked()
+            if seg.record_bytes + _HDR.size >= self.segment_bytes:
+                self._rotate_locked()
+            return lsn
 
     def _flush_locked(self) -> None:
         if not self._dirty:
@@ -73,25 +232,76 @@ class WAL:
     def flush(self) -> None:
         """Group commit: one write barrier for everything appended since
         the last flush (reference: rbf tx commit fsync)."""
+        plan = self.crash_plan
+        if plan is not None and not plan.fire("wal.flush"):
+            return
         with self._lock:
             self._flush_locked()
 
     @property
     def size(self) -> int:
+        """Total physical bytes across all segments (markers included)."""
         with self._lock:
             self._f.flush()
-            return os.path.getsize(self.path)
+            total = 0
+            for seg in self._segments:
+                try:
+                    total += os.path.getsize(seg.path)
+                except OSError:
+                    pass
+            return total
+
+    @property
+    def record_bytes(self) -> int:
+        """Bytes of actual records (markers excluded) — the checkpoint
+        trigger: 0 right after a checkpoint even though each fresh
+        segment physically holds its 16-byte marker."""
+        with self._lock:
+            return sum(seg.record_bytes for seg in self._segments)
 
     def truncate(self) -> None:
         """Drop all records — called after a checkpoint persisted the
-        planes they produced (reference: rbf/db.go WAL copy-back)."""
+        planes they subsume (reference: rbf/db.go WAL copy-back). The
+        LSN counter is NOT reset; segment numbering keeps climbing so a
+        crash mid-truncate never resurrects a reused name."""
         with self._lock:
             self._flush_locked()
             self._f.close()
-            self._f = open(self.path, "wb")
-            if self.sync != "never":
-                self._f.flush()
-                os.fsync(self._f.fileno())
+            next_seq = self._segments[-1].seq + 1
+            for seg in self._segments:
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+            self._segments = []
+            fsync_dir(self._dir)
+            self._new_segment_locked(next_seq)
+
+    def prune(self, upto_lsn: int) -> int:
+        """Fuzzy-checkpoint GC: rotate the active segment if it holds
+        records, then delete every SEALED segment whose records are all
+        <= ``upto_lsn``. A segment with any record above the checkpoint
+        LSN survives whole — replay is op-idempotent, so re-applying its
+        below-LSN prefix over the snapshot is harmless. Returns segments
+        removed."""
+        with self._lock:
+            if self._segments[-1].record_bytes > 0:
+                self._rotate_locked()
+            keep: List[_Segment] = []
+            removed = 0
+            for seg in self._segments[:-1]:
+                if seg.max_lsn <= upto_lsn:
+                    try:
+                        os.unlink(seg.path)
+                    except OSError:
+                        pass
+                    removed += 1
+                else:
+                    keep.append(seg)
+            self._segments = keep + self._segments[-1:]
+            if removed:
+                fsync_dir(self._dir)
+            return removed
 
     def close(self) -> None:
         with self._lock:
@@ -100,51 +310,132 @@ class WAL:
 
     # -- read side -----------------------------------------------------------
 
-    def records(self) -> Iterator[Tuple]:
-        """Replay iterator; stops silently at a torn/corrupt tail."""
+    def _frames(self, after_lsn: int = 0) -> Iterator[Tuple[int, Tuple, int]]:
+        """(lsn, record, frame bytes) for every intact record above
+        ``after_lsn``, across segments in order; markers skipped; stops
+        at the first torn/corrupt frame (tears only ever occur at the
+        true write frontier — sealed segments are immutable)."""
         with self._lock:
             self._f.flush()
-        with open(self.path, "rb") as f:
-            while True:
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    return
-                crc, n = _HDR.unpack(hdr)
-                payload = f.read(n)
-                if len(payload) < n or zlib.crc32(payload) != crc:
-                    return  # torn tail
-                yield pickle.loads(payload)
+            paths = [seg.path for seg in self._segments]
+        for path in paths:
+            try:
+                f = open(path, "rb")
+            except OSError:
+                continue
+            with f:
+                while True:
+                    hdr = f.read(_HDR.size)
+                    if len(hdr) < _HDR.size:
+                        if len(hdr) > 0:
+                            return  # torn header
+                        break  # clean segment end
+                    crc, n, lsn = _HDR.unpack(hdr)
+                    payload = f.read(n)
+                    if len(payload) < n or \
+                            zlib.crc32(_LSN.pack(lsn) + payload) != crc:
+                        return  # torn tail
+                    if n == 0:  # marker: valid, carries no record
+                        continue
+                    if lsn > after_lsn:
+                        yield lsn, pickle.loads(payload), _HDR.size + n
+
+    def replay(self, after_lsn: int = 0) -> Iterator[Tuple[int, Tuple, int]]:
+        """Replay iterator for recovery: (lsn, record, frame bytes) with
+        lsn > ``after_lsn`` (the checkpoint LSN)."""
+        return self._frames(after_lsn)
+
+    def records(self) -> Iterator[Tuple]:
+        """All intact records (compat surface; stops silently at a
+        torn/corrupt tail)."""
+        return (rec for _lsn, rec, _nb in self._frames(0))
 
     def valid_prefix(self) -> int:
-        """Byte length of the intact record prefix."""
+        """Byte length of the intact frame prefix across all segments."""
         with self._lock:
             self._f.flush()
+            paths = [seg.path for seg in self._segments]
         good = 0
-        with open(self.path, "rb") as f:
-            while True:
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    return good
-                crc, n = _HDR.unpack(hdr)
-                payload = f.read(n)
-                if len(payload) < n or zlib.crc32(payload) != crc:
-                    return good
-                good += _HDR.size + n
+        for path in paths:
+            valid, _rb, _ml, torn = _scan_segment(path)
+            good += valid
+            if torn or valid < os.path.getsize(path):
+                break
+        return good
 
     def repair(self) -> None:
         """Chop a torn tail so post-recovery appends don't land behind
         garbage (which the next replay would stop at, silently dropping
-        them). Called once after recovery replay."""
-        good = self.valid_prefix()
+        them). Segments after the torn one are unreachable by replay and
+        are dropped too. Called once after recovery replay."""
         with self._lock:
-            if good == os.path.getsize(self.path):
+            self._f.flush()
+            bad = None
+            for i, seg in enumerate(self._segments):
+                valid, rec_bytes, max_lsn, torn = _scan_segment(seg.path)
+                seg.record_bytes = rec_bytes
+                seg.max_lsn = max_lsn
+                if torn or valid < os.path.getsize(seg.path):
+                    bad = (i, valid)
+                    break
+            if bad is None:
                 return
+            i, valid = bad
             self._f.close()
-            with open(self.path, "r+b") as f:
-                f.truncate(good)
+            seg = self._segments[i]
+            with open(seg.path, "r+b") as f:
+                f.truncate(valid)
                 f.flush()
                 os.fsync(f.fileno())
-            self._f = open(self.path, "ab")
+            for later in self._segments[i + 1:]:
+                try:
+                    os.unlink(later.path)
+                except OSError:
+                    pass
+            self._segments = self._segments[:i + 1]
+            fsync_dir(self._dir)
+            self._f = open(seg.path, "ab")
+
+    # -- log shipping (storage/recovery.py catch-up) -------------------------
+
+    def tail_bytes(self, since_lsn: int,
+                   max_bytes: int = 1 << 20) -> Tuple[bytes, int, bool]:
+        """Raw CRC-framed bytes of records with lsn > ``since_lsn``:
+        (frames, last lsn included, more remaining). At least one frame
+        ships even when it alone exceeds ``max_bytes``; the receiver
+        parses with :func:`iter_frames` and applies idempotently."""
+        chunks: List[bytes] = []
+        total = 0
+        last = since_lsn
+        for lsn, rec, _nb in self._frames(since_lsn):
+            payload = pickle.dumps(rec, protocol=5)
+            framed = _HDR.pack(zlib.crc32(_LSN.pack(lsn) + payload),
+                               len(payload), lsn) + payload
+            if chunks and total + len(framed) > max_bytes:
+                return b"".join(chunks), last, True
+            chunks.append(framed)
+            total += len(framed)
+            last = lsn
+        return b"".join(chunks), last, False
+
+
+def iter_frames(data: bytes) -> Iterator[Tuple[int, Tuple]]:
+    """Parse shipped WAL frames (tail_bytes payloads): yields (lsn,
+    record); raises ValueError on a corrupt frame — shipped tails come
+    from intact segments, so damage means transport corruption, not a
+    tear to tolerate."""
+    off = 0
+    while off < len(data):
+        if off + _HDR.size > len(data):
+            raise ValueError("truncated WAL frame header")
+        crc, n, lsn = _HDR.unpack_from(data, off)
+        payload = data[off + _HDR.size: off + _HDR.size + n]
+        if len(payload) < n or zlib.crc32(_LSN.pack(lsn) + payload) != crc:
+            raise ValueError("corrupt WAL frame")
+        off += _HDR.size + n
+        if n == 0:
+            continue
+        yield lsn, pickle.loads(payload)
 
 
 def pack_plane(plane) -> bytes:

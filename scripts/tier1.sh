@@ -41,6 +41,16 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== crash-injection lane (PILOSA_TPU_CRASH_SEED=1 / 7) =="
+# Crash recovery must hold for ANY seeded kill point (the seed picks the
+# kill site and hit count); two fixed seeds exercise two distinct crash
+# schedules through the storage write path reproducibly.
+for seed in 1 7; do
+    PILOSA_TPU_CRASH_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_recovery.py -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -149,7 +149,7 @@ class TestDataframePersistence:
         api.import_dataframe("t", 0, [1, 2], {"fare": [5.0, 6.0],
                                               "n": [1, 2]})
         api.save()
-        assert api.holder.index("t").wal.size == 0
+        assert api.holder.index("t").wal.record_bytes == 0
         del api
         api2 = API(str(tmp_path))
         assert api2.dataframe_schema("t") == [

@@ -1,0 +1,615 @@
+"""Crash-consistent recovery plane: segmented WAL + checkpoints,
+kill-point crash injection, replica catch-up via log shipping.
+
+The core invariant, asserted from three directions:
+
+* a crash at ANY byte of the write path leaves a snapshot + WAL tail
+  that replays to exactly the last flushed commit (kill-point matrix vs
+  an uncrashed oracle);
+* the same WAL tail applied twice produces identical planes (replay
+  idempotence, which is what makes fuzzy checkpoints and catch-up
+  overlap safe);
+* a lagging replica catches up over /internal/recovery/{snapshot,wal}
+  to answer bit-identically, with mid-catch-up writes queued.
+
+``PILOSA_TPU_CRASH_SEED`` (scripts/tier1.sh crash lane) steers the
+seeded kill point the same way PILOSA_TPU_FAULT_SEED steers RPC faults.
+"""
+
+import os
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.cluster.resilience import FaultPlan
+from pilosa_tpu.config import Config
+from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.storage.recovery import (
+    CHECKPOINT_META, CRASH_SITES, CrashPlan, RecoveryManager,
+    SimulatedCrash, abandon_holder, attach_crash_plan, crash_workload,
+    filter_record, oracle_checksums, read_checkpoint_meta, record_shards,
+    run_crash_point, write_checkpoint_meta,
+)
+from pilosa_tpu.storage.wal import WAL, iter_frames
+
+
+# -- segmented WAL -----------------------------------------------------------
+
+
+class TestSegmentedWAL:
+    def test_rotation_produces_numbered_segments(self, tmp_path):
+        w = WAL(str(tmp_path / "wal.log"), segment_bytes=64)
+        lsns = [w.append(("set_bit", "f", "", i, i)) for i in range(8)]
+        w.flush()
+        assert lsns == sorted(lsns) and len(set(lsns)) == 8
+        segs = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("wal.log."))
+        assert len(segs) > 1  # 64-byte segments force rotation
+        assert segs[0] == "wal.log.00000001"
+        assert [r for r in w.records()] == \
+            [("set_bit", "f", "", i, i) for i in range(8)]
+        w.close()
+
+    def test_lsn_survives_reopen_and_truncate(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = WAL(p, segment_bytes=64)
+        for i in range(5):
+            w.append(("set_bit", "f", "", 0, i))
+        w.flush()
+        top = w.last_lsn
+        w.close()
+        w2 = WAL(p, segment_bytes=64)
+        assert w2.last_lsn == top
+        old_seqs = {int(q.name.rsplit(".", 1)[1]) for q in tmp_path.iterdir()}
+        w2.truncate()
+        assert w2.last_lsn == top  # the counter NEVER resets
+        new_seqs = {int(q.name.rsplit(".", 1)[1]) for q in tmp_path.iterdir()}
+        assert min(new_seqs) > max(old_seqs)  # fresh segment, later seq
+        assert w2.append(("set_bit", "f", "", 0, 9)) == top + 1
+        w2.close()
+
+    def test_prune_drops_only_wholly_covered_segments(self, tmp_path):
+        w = WAL(str(tmp_path / "wal.log"), segment_bytes=64)
+        lsns = [w.append(("set_bit", "f", "", 0, i)) for i in range(9)]
+        w.flush()
+        n_before = len(list(tmp_path.iterdir()))
+        assert n_before > 2
+        mid = lsns[4]
+        w.prune(mid)
+        # every record above the checkpoint LSN must still replay
+        kept = [lsn for lsn, _rec, _n in w.replay(after_lsn=mid)]
+        assert kept == lsns[5:]
+        # and pruning everything leaves the (empty) active segment only
+        w.prune(w.last_lsn)
+        assert w.record_bytes == 0
+        assert list(w.records()) == []
+        w.close()
+
+    def test_legacy_single_file_adopted_as_segment(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = WAL(p)
+        w.append(("set_bit", "f", "", 1, 2))
+        w.flush()
+        w.close()
+        # simulate a pre-segmentation install: one bare wal.log file
+        os.rename(w.path, p)
+        for q in tmp_path.iterdir():
+            assert q.name == "wal.log"
+        w2 = WAL(p)
+        assert list(w2.records()) == [("set_bit", "f", "", 1, 2)]
+        assert not os.path.exists(p)  # renamed into the segment scheme
+        w2.close()
+
+
+class TestTornTailVsMarker:
+    def test_byte_exact_torn_tail_drops_only_last_write(self, tmp_path):
+        """Regression for the zero-payload/torn-header conflation: a tear
+        at any byte of the final frame must drop that frame only."""
+        recs = [("set_bit", "f", "", 0, 1), ("import_bits", "f", [1], [9])]
+        p = str(tmp_path / "wal.log")
+        w = WAL(p)
+        w.append(recs[0])
+        w.flush()
+        size_first = os.path.getsize(w.path)
+        w.append(recs[1])
+        w.flush()
+        active = w.path
+        w.close()
+        with open(active, "rb") as f:
+            blob = f.read()
+        assert size_first < len(blob)
+        for cut in range(size_first, len(blob)):  # every torn byte count
+            with open(active, "wb") as f:
+                f.write(blob[:cut])
+            w2 = WAL(p)
+            assert list(w2.records()) == recs[:1], f"cut at {cut} bytes"
+            w2.close()
+        # restoring the full file yields both again
+        with open(active, "wb") as f:
+            f.write(blob)
+        w3 = WAL(p)
+        assert list(w3.records()) == recs
+        w3.close()
+
+    def test_segment_markers_do_not_stop_replay(self, tmp_path):
+        """Each segment opens with a zero-payload marker frame; replay
+        must skip them, not treat them as a tear (the old behavior)."""
+        w = WAL(str(tmp_path / "wal.log"), segment_bytes=1)  # rotate always
+        recs = [("set_bit", "f", "", 0, i) for i in range(4)]
+        for r in recs:
+            w.append(r)
+        w.flush()
+        assert len(list(tmp_path.iterdir())) >= 4  # one record per segment
+        assert list(w.records()) == recs
+        w.close()
+
+    def test_corrupt_interior_byte_stops_at_tear(self, tmp_path):
+        w = WAL(str(tmp_path / "wal.log"))
+        w.append(("set_bit", "f", "", 0, 1))
+        w.append(("set_bit", "f", "", 0, 2))
+        w.flush()
+        active = w.path
+        w.close()
+        with open(active, "r+b") as f:
+            f.seek(20)  # inside the first record's frame (after marker)
+            b = f.read(1)
+            f.seek(20)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert list(WAL(str(tmp_path / "wal.log")).records()) == []
+
+    def test_repair_truncates_to_valid_prefix(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = WAL(p)
+        w.append(("set_bit", "f", "", 0, 1))
+        w.flush()
+        good = os.path.getsize(w.path)
+        active = w.path
+        w.close()
+        with open(active, "ab") as f:
+            f.write(b"\x01\x02\x03")  # torn garbage
+        w2 = WAL(p)
+        w2.repair()
+        assert os.path.getsize(active) == good
+        assert list(w2.records()) == [("set_bit", "f", "", 0, 1)]
+        w2.close()
+
+
+class TestTailShipping:
+    def test_tail_bytes_round_trips_through_iter_frames(self, tmp_path):
+        w = WAL(str(tmp_path / "wal.log"), segment_bytes=96)
+        recs = [("import_bits", "f", [i], [i * 3]) for i in range(6)]
+        lsns = [w.append(r) for r in recs]
+        w.flush()
+        data, last, more = w.tail_bytes(0)
+        assert not more and last == lsns[-1]
+        assert [r for _lsn, r in iter_frames(data)] == recs
+        # a mid-stream cursor ships only the strictly-later records
+        data2, last2, _ = w.tail_bytes(lsns[2])
+        assert [r for _l, r in iter_frames(data2)] == recs[3:]
+        assert last2 == lsns[-1]
+        w.close()
+
+    def test_tail_bytes_paginates(self, tmp_path):
+        w = WAL(str(tmp_path / "wal.log"), segment_bytes=96)
+        recs = [("import_bits", "f", [i], [i]) for i in range(6)]
+        for r in recs:
+            w.append(r)
+        w.flush()
+        got, since, rounds = [], 0, 0
+        while True:
+            data, last, more = w.tail_bytes(since, max_bytes=64)
+            got.extend(r for _l, r in iter_frames(data))
+            rounds += 1
+            since = last
+            if not more:
+                break
+        assert got == recs and rounds > 1
+        w.close()
+
+    def test_iter_frames_rejects_corrupt_stream(self):
+        with pytest.raises(ValueError):
+            list(iter_frames(b"\x00" * 20))
+
+
+# -- record shard filtering ---------------------------------------------------
+
+
+class TestRecordFiltering:
+    def test_record_shards(self):
+        W = SHARD_WIDTH
+        # set_bit records are (op, field, row, col, ts) — col at [3]
+        assert record_shards(("set_bit", "f", 3, W + 1, None), W) == {1}
+        assert record_shards(("clear_bit", "f", 3, 2 * W), W) == {2}
+        assert record_shards(("import_bits", "f", [1, 2], [0, 2 * W]), W) \
+            == {0, 2}
+        assert record_shards(("set_values", "f", [0, W], [7, 8]), W) == {0, 1}
+        assert record_shards(("row_plane", "f", b"", 5), W) == {5}
+        assert record_shards(("clear_value", "f", W + 3), W) == {1}
+        assert record_shards(("df_changeset", "t", 2, {}), W) == {2}
+        assert record_shards(("delete_field", "f"), W) is None
+
+    def test_filter_record_subsets_pairwise(self):
+        W = SHARD_WIDTH
+        rec = ("import_bits", "f", [1, 2, 3], [0, W, 2 * W])
+        out = filter_record(rec, lambda s: s == 1, W)
+        assert out == ("import_bits", "f", [2], [W])
+        rec2 = ("set_values", "f", [0, W], [7, 8])
+        assert filter_record(rec2, lambda s: s == 0, W) \
+            == ("set_values", "f", [0], [7])
+        assert filter_record(rec, lambda s: s == 9, W) is None
+        # index-wide records always pass
+        assert filter_record(("clear_row", "f", "", 3), lambda s: False, W) \
+            == ("clear_row", "f", "", 3)
+
+
+# -- checkpoint metadata ------------------------------------------------------
+
+
+class TestCheckpointMeta:
+    def test_roundtrip_and_missing(self, tmp_path):
+        assert read_checkpoint_meta(str(tmp_path)) == 0
+        assert read_checkpoint_meta(None) == 0
+        write_checkpoint_meta(str(tmp_path), 42)
+        assert read_checkpoint_meta(str(tmp_path)) == 42
+        write_checkpoint_meta(str(tmp_path), 43)  # atomic replace
+        assert read_checkpoint_meta(str(tmp_path)) == 43
+
+    def test_checkpoint_stamps_lsn_and_prunes(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.import_bits("i", "f", rows=[0, 1], cols=[3, 9])
+        idx = api.holder.index("i")
+        assert idx.wal.record_bytes > 0
+        api.save()  # checkpoint: snapshot + meta + prune
+        assert idx.wal.record_bytes == 0
+        meta = os.path.join(api.holder._index_path("i"), CHECKPOINT_META)
+        assert os.path.isfile(meta)
+        assert read_checkpoint_meta(api.holder._index_path("i")) \
+            == idx.wal.last_lsn
+
+    def test_recovery_replays_only_above_checkpoint(self, tmp_path):
+        api = API(str(tmp_path))
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.import_bits("i", "f", rows=[0], cols=[1])
+        api.save()
+        api.import_bits("i", "f", rows=[1], cols=[2])  # tail, not pruned
+        want = api.checksum()
+        api.holder.flush_wals()
+        del api
+        api2 = API(str(tmp_path))
+        assert api2.checksum() == want
+        assert api2.query("i", "Row(f=1)")[0].columns == [2]
+
+
+# -- kill-point crash injection ----------------------------------------------
+
+
+def _assert_oracle_prefix(result, oracle):
+    """A crash may lose unacked work, never acked work, and never leave
+    a state that is not an exact committed prefix."""
+    assert result["checksum"] in oracle, "recovered state not a prefix"
+    k = oracle.index(result["checksum"])
+    assert k >= result["acked"], \
+        f"acked batch lost: recovered prefix {k} < acked {result['acked']}"
+
+
+class TestCrashInjection:
+    # 5 sites x 6 hit counts (checkpoint-per-commit arms the savez and
+    # checkpoint sites) + 6 pure-WAL points below = 36 kill points.
+    @pytest.mark.parametrize("site", CRASH_SITES)
+    @pytest.mark.parametrize("at", [1, 2, 3, 4, 5, 6])
+    def test_kill_point_matrix(self, tmp_path, site, at):
+        batches = crash_workload(n_batches=6)
+        oracle = oracle_checksums(str(tmp_path), batches)
+        plan = CrashPlan().kill(site, at=at)
+        res = run_crash_point(str(tmp_path), plan, batches,
+                              checkpoint_bytes=1)
+        _assert_oracle_prefix(res, oracle)
+        if not res["crashed"]:  # the site never reached its hit count
+            assert res["checksum"] == oracle[-1]
+
+    @pytest.mark.parametrize("site", ["wal.append", "wal.flush"])
+    @pytest.mark.parametrize("at", [1, 2, 3])
+    def test_kill_point_no_checkpoint(self, tmp_path, site, at):
+        """The WAL sites again, without per-commit checkpoints: the tail
+        alone must carry recovery."""
+        batches = crash_workload(n_batches=6, seed=1)
+        oracle = oracle_checksums(str(tmp_path), batches)
+        res = run_crash_point(str(tmp_path), CrashPlan().kill(site, at=at),
+                              batches)
+        assert res["crashed"]
+        _assert_oracle_prefix(res, oracle)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_seeded_crash_points(self, tmp_path, seed):
+        """Seed-derived plans (the tier1.sh crash lane dialect): same
+        seed, same kill point, forever."""
+        batches = crash_workload(n_batches=6, seed=seed)
+        oracle = oracle_checksums(str(tmp_path), batches)
+        plan = CrashPlan.seeded(seed)
+        assert plan._arms == CrashPlan.seeded(seed)._arms  # deterministic
+        res = run_crash_point(str(tmp_path), plan, batches,
+                              checkpoint_bytes=1)
+        _assert_oracle_prefix(res, oracle)
+
+    def test_env_seeded_plan(self, tmp_path):
+        """The crash lane sets PILOSA_TPU_CRASH_SEED; default runs use a
+        fixed fallback so the test always exercises a real plan."""
+        plan = CrashPlan.from_env() or CrashPlan.seeded("lane-default")
+        batches = crash_workload(n_batches=6, seed=9)
+        oracle = oracle_checksums(str(tmp_path), batches)
+        res = run_crash_point(str(tmp_path), plan, batches,
+                              checkpoint_bytes=1)
+        _assert_oracle_prefix(res, oracle)
+
+    def test_from_env_parses(self, monkeypatch):
+        monkeypatch.delenv("PILOSA_TPU_CRASH_SEED", raising=False)
+        assert CrashPlan.from_env() is None
+        monkeypatch.setenv("PILOSA_TPU_CRASH_SEED", "7")
+        plan = CrashPlan.from_env()
+        assert plan is not None and plan._arms == CrashPlan.seeded("7")._arms
+
+    def test_dead_plan_noops_instead_of_rearming(self):
+        plan = CrashPlan().kill("wal.append", at=1)
+        with pytest.raises(SimulatedCrash):
+            plan.fire("wal.append")
+        assert plan.dead and plan.fired == ("wal.append", 1)
+        # the dead 'process' performs no IO: every later fire says skip
+        assert plan.fire("wal.append") is False
+        assert plan.fire("wal.flush") is False
+
+    def test_abandon_holder_loses_buffered_bytes(self, tmp_path):
+        """The harness's crash fidelity: unflushed python-buffered bytes
+        must NOT survive abandon + reopen (a plain close would flush)."""
+        api = API(str(tmp_path))
+        api.create_index("ci", {"trackExistence": False})
+        api.create_field("ci", "f")
+        api.save()
+        idx = api.holder.index("ci")
+        idx.wal.sync = "never"  # keep bytes in the BufferedWriter
+        with api.holder.write_lock:
+            idx.wal.append(("set_bit", "f", "", 0, 1))
+        abandon_holder(api.holder)
+        api2 = API(str(tmp_path))
+        assert api2.query("ci", "Row(f=0)")[0].columns == []
+
+
+# -- replay idempotence -------------------------------------------------------
+
+
+class TestReplayIdempotence:
+    def _source(self, path):
+        api = API(path)
+        api.create_index("i", {"keys": True})
+        api.create_field("i", "f")
+        api.create_field("i", "b", {"type": "int", "min": 0, "max": 1000})
+        api.import_bits("i", "f", rows=[0, 1, 0], cols=[3, 9, SHARD_WIDTH])
+        api.query("i", "Clear(9, f=1)")
+        api.import_values("i", "b", cols=[3, 9], values=[10, 20])
+        api.query("i", "Clear(9, b=20)")
+        api.import_bits("i", "f", rows=[2], col_keys=["k1"])  # translate
+        api.holder.flush_wals()
+        return api
+
+    @pytest.mark.parametrize("times", [1, 2, 3])
+    def test_same_tail_applied_n_times_is_identical(self, tmp_path, times):
+        src = self._source(str(tmp_path / "src"))
+        recs = list(src.holder.index("i").wal.records())
+        assert len(recs) >= 5
+
+        replica = API(str(tmp_path / f"rep{times}"))
+        replica.create_index("i", {"keys": True})
+        replica.create_field("i", "f")
+        replica.create_field("i", "b", {"type": "int", "min": 0,
+                                        "max": 1000})
+        idx = replica.holder.index("i")
+        checks = []
+        for _ in range(times):
+            with replica.holder.write_lock:
+                n = replica.holder.replay_records(idx, recs)
+            assert n == len(recs)
+            checks.append(replica.checksum())
+        assert len(set(checks)) == 1, "replay is not idempotent"
+        # and the planes match the source bit-for-bit
+        for pql in ("Row(f=0)", "Row(f=1)", "Row(f=2)", "Row(b > 5)"):
+            assert replica.query("i", pql)[0].columns == \
+                src.query("i", pql)[0].columns
+
+
+# -- configuration ------------------------------------------------------------
+
+
+class TestRecoveryConfig:
+    def test_toml_section_and_env_override(self, tmp_path):
+        cfg_file = tmp_path / "pt.toml"
+        cfg_file.write_text(
+            "[storage.recovery]\n"
+            "segment-bytes = 8192\n"
+            "checkpoint-interval-bytes = 4096\n"
+            "catchup-batch-bytes = 2048\n")
+        cfg = Config.from_sources(toml_path=str(cfg_file), env={})
+        assert cfg.storage_recovery_segment_bytes == 8192
+        assert cfg.storage_recovery_checkpoint_interval_bytes == 4096
+        assert cfg.storage_recovery_catchup_batch_bytes == 2048
+        cfg2 = Config.from_sources(
+            toml_path=str(cfg_file),
+            env={"PILOSA_TPU_STORAGE_RECOVERY_SEGMENT_BYTES": "123",
+                 "PILOSA_TPU_STORAGE_RECOVERY_CATCHUP_BATCH_BYTES": "77"})
+        assert cfg2.storage_recovery_segment_bytes == 123  # env wins
+        assert cfg2.storage_recovery_catchup_batch_bytes == 77
+        assert cfg2.storage_recovery_checkpoint_interval_bytes == 4096
+
+    def test_defaults(self):
+        cfg = Config.from_sources(env={})
+        assert cfg.storage_recovery_segment_bytes == 4 << 20
+        assert cfg.storage_recovery_checkpoint_interval_bytes == 0
+        assert cfg.storage_recovery_catchup_batch_bytes == 1 << 20
+
+    def test_manager_from_config(self, tmp_path):
+        with LocalCluster(1, base_path=str(tmp_path)) as c:
+            cfg = Config.from_sources(
+                env={"PILOSA_TPU_STORAGE_RECOVERY_CATCHUP_BATCH_BYTES":
+                     "4096"})
+            rm = RecoveryManager.from_config(c.nodes[0], cfg)
+            assert rm.batch_bytes == 4096
+            rm2 = RecoveryManager.from_config(c.nodes[0], cfg,
+                                              batch_bytes=99)
+            assert rm2.batch_bytes == 99  # explicit override wins
+
+
+# -- replica catch-up ---------------------------------------------------------
+
+
+def _lag_node2(c):
+    """Schema + an initial replicated write, then writes that land only
+    on node0/node1 (node2 'was down' for them)."""
+    c.coordinator.create_index("i")
+    c.coordinator.create_field("i", "f")
+    c.coordinator.import_bits("i", "f", rows=[0, 1, 2, 0],
+                              cols=[1, 5, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 1])
+    c.run_gossip_rounds(2)
+    for n in c.nodes[:2]:
+        n.api.import_bits("i", "f", rows=[3, 3, 1],
+                          cols=[7, SHARD_WIDTH + 2, 9])
+        n._announce_shards("i")
+    c.run_gossip_rounds(3)
+
+
+class TestReplicaCatchUp:
+    def test_lagging_detects_strictly_ahead_peers(self, tmp_path):
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery()
+            _lag_node2(c)
+            lag = rm.lagging("i")
+            assert set(lag) == {"node0", "node1"}
+            assert all(shards for shards in lag.values())
+            # up-to-date nodes see no lag anywhere
+            rm0 = c.nodes[0].enable_recovery()
+            assert rm0.lagging("i") == {}
+
+    def test_catch_up_converges_bit_identically(self, tmp_path):
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery()
+            _lag_node2(c)
+            assert c.nodes[2].api.checksum() != c.nodes[0].api.checksum()
+            summary = rm.catch_up()
+            assert summary["shards"] > 0
+            sums = [n.api.checksum() for n in c.nodes]
+            assert sums[0] == sums[1] == sums[2]
+            assert c.nodes[2].query("i", "Row(f=3)")[0].columns == \
+                [7, SHARD_WIDTH + 2]
+            # second run: nothing left to repair
+            again = rm.catch_up()
+            assert again["shards"] == 0 and again["indexes"] == []
+
+    def test_catch_up_under_injected_faults(self, tmp_path):
+        """Dropped + delayed recovery RPCs are absorbed by the client's
+        retry/backoff; catch-up still converges."""
+        plan = (FaultPlan(seed=3)
+                .drop("node0", first=0, count=1, op="recovery")
+                .delay("node0", 0.01, first=1, count=2, op="recovery")
+                .drop("node1", first=0, count=1, op="recovery"))
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path),
+                          fault_plan=plan) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery()
+            _lag_node2(c)
+            summary = rm.catch_up()
+            assert summary["shards"] > 0
+            sums = [n.api.checksum() for n in c.nodes]
+            assert sums[0] == sums[1] == sums[2]
+
+    def test_writes_queue_during_catch_up_and_drain_after(self, tmp_path):
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            c.coordinator.create_index("i")
+            c.coordinator.create_field("i", "f")
+            rm = c.nodes[2].enable_recovery()
+            rm.begin("i")
+            # a forwarded write arriving mid-catch-up must queue, not apply
+            n = c.nodes[2].import_bits("i", "f", rows=[5], cols=[6],
+                                       remote=True)
+            assert n == 0
+            assert c.nodes[2].api.query("i", "Row(f=5)")[0].columns == []
+            assert rm.drain() == 1
+            assert c.nodes[2].api.query("i", "Row(f=5)")[0].columns == [6]
+            # drained: the next remote write applies immediately
+            c.nodes[2].import_bits("i", "f", rows=[5], cols=[8],
+                                   remote=True)
+            assert c.nodes[2].api.query("i", "Row(f=5)")[0].columns == [6, 8]
+
+    def test_catch_up_gossips_breaker_open_then_closed(self, tmp_path):
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery()
+            _lag_node2(c)
+            states = []
+            orig = c.nodes[2].gossip.record_breaker
+
+            def spy(node_id, state, **kw):
+                states.append((node_id, state))
+                return orig(node_id, state, **kw)
+
+            c.nodes[2].gossip.record_breaker = spy
+            rm.catch_up()
+            assert ("node2", "open") in states
+            assert ("node2", "closed") in states
+            assert states.index(("node2", "open")) < \
+                states.index(("node2", "closed"))
+
+    def test_recovery_endpoints_ship_snapshot_and_tail(self, tmp_path):
+        """The transport itself: /internal/recovery/snapshot returns an
+        installable npz + LSN; /internal/recovery/wal ships CRC-framed
+        records above a cursor."""
+        import base64
+
+        with LocalCluster(2, replica_n=2, base_path=str(tmp_path)) as c:
+            c.coordinator.create_index("i")
+            c.coordinator.create_field("i", "f")
+            c.coordinator.import_bits("i", "f", rows=[0, 1], cols=[3, 9])
+            peer = c.nodes[0].node
+            client = c.nodes[1].client
+            snap = client.recovery_snapshot(peer, "i", 0)
+            assert snap["lsn"] > 0 and snap["npz"]
+            tail = client.recovery_wal(peer, "i", 0, 1 << 20)
+            frames = base64.b64decode(tail["frames"])
+            recs = [r for _lsn, r in iter_frames(frames)]
+            assert any(r[0] == "import_bits" for r in recs)
+            assert tail["last_lsn"] == snap["lsn"] and not tail["more"]
+            # a cursor at the tip ships nothing
+            empty = client.recovery_wal(peer, "i", tail["last_lsn"], 1 << 20)
+            assert base64.b64decode(empty["frames"]) == b""
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+class TestRecoveryMetrics:
+    def test_checkpoint_and_catchup_metrics_exposed(self, tmp_path):
+        api = API(str(tmp_path / "a"))
+        api.create_index("i")
+        api.create_field("i", "f")
+        api.import_bits("i", "f", rows=[0], cols=[1])
+        base = M.REGISTRY.summary(M.METRIC_RECOVERY_CHECKPOINT_SECONDS)[0]
+        api.save()
+        assert M.REGISTRY.summary(
+            M.METRIC_RECOVERY_CHECKPOINT_SECONDS)[0] == base + 1
+        text = M.REGISTRY.prometheus_text()
+        assert "recovery_checkpoint_seconds" in text
+
+    def test_catch_up_counts_shards_and_lag(self, tmp_path):
+        reg = M.MetricsRegistry()
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery(registry=reg)
+            _lag_node2(c)
+            rm.catch_up()
+            assert reg.value(M.METRIC_RECOVERY_CATCHUP_SHARDS) > 0
+            h = reg.histogram(M.METRIC_RECOVERY_CATCHUP_LAG_MS)
+            assert h is not None and h["count"] == 1

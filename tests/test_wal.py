@@ -102,8 +102,8 @@ class TestCrashRecovery:
         api.create_index("i")
         api.create_field("i", "f")
         api.query("i", "Set(1, f=1)")
-        api.save()  # checkpoint: snapshot + WAL truncate
-        assert api.holder.index("i").wal.size == 0
+        api.save()  # checkpoint: snapshot + WAL segments pruned
+        assert api.holder.index("i").wal.record_bytes == 0
         api.query("i", "Set(2, f=1)")  # tail after checkpoint
         del api
         api2 = reopen(tmp_path)
@@ -147,8 +147,8 @@ class TestCrashRecovery:
         api.create_index("i")
         api.create_field("i", "f")
         api.query("i", "Set(1, f=1)")
-        # qcx.finish ran maybe_checkpoint -> WAL truncated, snapshot exists
-        assert api.holder.index("i").wal.size == 0
+        # qcx.finish ran maybe_checkpoint -> records pruned, snapshot exists
+        assert api.holder.index("i").wal.record_bytes == 0
         del api
         api2 = reopen(tmp_path)
         assert api2.query("i", "Row(f=1)")[0].columns == [1]
