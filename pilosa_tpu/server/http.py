@@ -882,8 +882,13 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, {"frames": "", "last_lsn": since,
                              "more": False, "floor_lsn": 0})
             return
-        floor = read_checkpoint_meta(holder._index_path(index))
         frames, last, more = idx.wal.tail_bytes(since, max_bytes)
+        # meta AFTER the tail read: checkpoint stamps meta before it
+        # prunes, so any prune that could have removed segments while
+        # tail_bytes ran is visible in this floor — a tail gapped by a
+        # racing prune always arrives with floor > since, forcing the
+        # caller to re-snapshot instead of applying the gap
+        floor = read_checkpoint_meta(holder._index_path(index))
         self._send(200, {
             "frames": base64.b64encode(frames).decode(),
             "last_lsn": last, "more": more, "floor_lsn": floor,

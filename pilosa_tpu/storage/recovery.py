@@ -355,7 +355,7 @@ class RecoveryManager:
         self.registry = registry if registry is not None else M.REGISTRY
         self._lock = threading.Lock()
         self._active: Set[str] = set()  # indexes mid-catch-up
-        self._queued: List[Callable[[], Any]] = []
+        self._queued: Dict[str, List[Callable[[], Any]]] = {}
 
     @classmethod
     def from_config(cls, node, config=None, **overrides):
@@ -385,14 +385,22 @@ class RecoveryManager:
         with self._lock:
             if index not in self._active:
                 return False
-            self._queued.append(fn)
+            self._queued.setdefault(index, []).append(fn)
         self.registry.count(M.METRIC_RECOVERY_CATCHUP_QUEUED)
         return True
 
-    def drain(self) -> int:
+    def drain(self, indexes=None) -> int:
+        """Un-mark ``indexes`` (all when None) as catching up and apply
+        their queued writes. Per-index: a catch_up run drains only the
+        indexes IT marked active, so two overlapping runs on different
+        indexes can't release each other's queues mid-replay."""
         with self._lock:
-            fns, self._queued = self._queued, []
-            self._active.clear()
+            names = set(self._active) | set(self._queued) \
+                if indexes is None else set(indexes)
+            fns: List[Callable[[], Any]] = []
+            for name in names:
+                self._active.discard(name)
+                fns.extend(self._queued.pop(name, []))
         for fn in fns:
             try:
                 fn()
@@ -457,6 +465,7 @@ class RecoveryManager:
             # toward us (local evidence still outranks — see
             # CircuitBreaker.apply_remote)
             agent.record_breaker(self.node.node.id, "open")
+        ok = False
         try:
             for name, by_origin in plans.items():
                 # each lagging shard repairs from exactly one peer (first
@@ -473,10 +482,17 @@ class RecoveryManager:
                     summary["records"] += st["records"]
                     summary["bytes"] += st["bytes"]
             holder.checkpoint()  # make the repaired planes durable
+            ok = True
         finally:
-            summary["queued"] = self.drain()
+            # queued writes always apply (they were accepted; replay
+            # idempotence makes re-shipping them on a retry harmless),
+            # but only a COMPLETED repair may advertise us queryable —
+            # a failed run stays open so peers keep routing reads away,
+            # and the error propagates so the caller retries catch_up
+            summary["queued"] = self.drain(plans)
             if agent is not None:
-                agent.record_breaker(self.node.node.id, "closed")
+                agent.record_breaker(
+                    self.node.node.id, "closed" if ok else "open")
                 agent.refresh_local()
         lag_ms = (time.perf_counter() - t0) * 1e3
         self.registry.observe_bucketed(

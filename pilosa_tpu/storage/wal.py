@@ -48,6 +48,9 @@ from typing import Iterator, List, Optional, Tuple
 # crc32 over (lsn bytes || payload), payload length, lsn
 _HDR = struct.Struct("<IIQ")
 _LSN = struct.Struct("<Q")
+# pre-segmentation framing: crc32 over payload alone, payload length —
+# no LSN. Only ever seen in a bare <base> file left by an old install.
+_LEGACY_HDR = struct.Struct("<II")
 _SEG_RE = re.compile(r"\.(\d{8})$")
 
 DEFAULT_SEGMENT_BYTES = 4 << 20
@@ -92,6 +95,24 @@ def _scan_segment(path: str) -> Tuple[int, int, int, bool]:
                 rec_bytes += _HDR.size + n
             max_lsn = max(max_lsn, lsn)
     return valid, rec_bytes, max_lsn, torn
+
+
+def _scan_legacy(path: str) -> List[bytes]:
+    """Payloads of the intact prefix of a pre-segmentation ``<II>``-framed
+    log (crc over payload only, no LSN); stops at the first torn/corrupt
+    frame. An empty list means the file carries no legacy records."""
+    out: List[bytes] = []
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_LEGACY_HDR.size)
+            if len(hdr) < _LEGACY_HDR.size:
+                break
+            crc, n = _LEGACY_HDR.unpack(hdr)
+            payload = f.read(n)
+            if len(payload) < n or zlib.crc32(payload) != crc:
+                break
+            out.append(payload)
+    return out
 
 
 class _Segment:
@@ -144,21 +165,54 @@ class WAL:
             if m:
                 seqs.append(int(m.group(1)))
         seqs.sort()
-        if os.path.isfile(self.base):
-            # adopt a pre-segmentation single-file log as the next segment
-            seq = (seqs[-1] + 1) if seqs else 1
-            os.rename(self.base, self._seg_path(seq))
-            fsync_dir(self._dir)
-            seqs.append(seq)
         for seq in seqs:
             p = self._seg_path(seq)
             _valid, rec_bytes, max_lsn, _torn = _scan_segment(p)
             self._segments.append(_Segment(seq, p, rec_bytes, max_lsn))
             self._lsn = max(self._lsn, max_lsn)
+        if os.path.isfile(self.base):
+            self._adopt_base()
         if self._segments:
             self._f = open(self._segments[-1].path, "ab")
         else:
             self._new_segment_locked(1)
+
+    def _adopt_base(self) -> None:
+        """Adopt a pre-segmentation single-file ``<base>`` log as the
+        next segment. A file already in segment framing (or empty) is
+        renamed in place; a legacy ``<II>``-framed log (old installs:
+        crc over payload, no LSN) is rewritten frame-by-frame with
+        synthesized LSNs — renaming it untouched would make every frame
+        fail the new crc-over-(lsn||payload) check, scan as torn at byte
+        0, and get silently truncated by the first repair()."""
+        seq = (self._segments[-1].seq + 1) if self._segments else 1
+        path = self._seg_path(seq)
+        valid, _rb, _ml, torn = _scan_segment(self.base)
+        legacy = _scan_legacy(self.base) if valid == 0 and torn else []
+        if not legacy:
+            os.rename(self.base, path)
+            fsync_dir(self._dir)
+            _valid, rec_bytes, max_lsn, _torn = _scan_segment(path)
+            self._segments.append(_Segment(seq, path, rec_bytes, max_lsn))
+            self._lsn = max(self._lsn, max_lsn)
+            return
+        tmp = path + ".tmp"
+        rec_bytes = 0
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(zlib.crc32(_LSN.pack(self._lsn)), 0,
+                              self._lsn))
+            for payload in legacy:
+                self._lsn += 1
+                f.write(_HDR.pack(
+                    zlib.crc32(_LSN.pack(self._lsn) + payload),
+                    len(payload), self._lsn) + payload)
+                rec_bytes += _HDR.size + len(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        os.unlink(self.base)
+        fsync_dir(self._dir)
+        self._segments.append(_Segment(seq, path, rec_bytes, self._lsn))
 
     def _seg_path(self, seq: int) -> str:
         return f"{self.base}.{seq:08d}"

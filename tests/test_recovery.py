@@ -102,6 +102,53 @@ class TestSegmentedWAL:
         assert not os.path.exists(p)  # renamed into the segment scheme
         w2.close()
 
+    def test_legacy_ii_framed_log_converted_not_truncated(self, tmp_path):
+        """Regression: a TRUE pre-segmentation log uses <II> framing
+        (crc over payload alone, no LSN). Renaming it untouched fails
+        every new-framing CRC, scans as torn at byte 0, and the first
+        repair() silently truncates all its committed records; adoption
+        must rewrite it with synthesized LSNs instead."""
+        import pickle
+        import struct
+        import zlib
+
+        recs = [("set_bit", "f", "", r, r + 1) for r in range(5)]
+        p = str(tmp_path / "wal.log")
+        with open(p, "wb") as f:
+            for rec in recs:
+                payload = pickle.dumps(rec, protocol=5)
+                f.write(struct.pack("<II", zlib.crc32(payload),
+                                    len(payload)) + payload)
+        w = WAL(p)
+        assert not os.path.exists(p)  # converted into the segment scheme
+        assert list(w.records()) == recs
+        assert [lsn for lsn, _r, _n in w.replay(0)] == [1, 2, 3, 4, 5]
+        w.repair()  # a no-op: the converted segment is intact
+        assert list(w.records()) == recs
+        assert w.append(("set_bit", "f", "", 9, 9)) == 6  # LSNs continue
+        w.flush()
+        w.close()
+        w2 = WAL(p)  # stable across a second open
+        assert len(list(w2.records())) == 6
+        w2.close()
+
+    def test_legacy_log_torn_tail_keeps_intact_prefix(self, tmp_path):
+        import pickle
+        import struct
+        import zlib
+
+        recs = [("set_bit", "f", "", r, r) for r in range(3)]
+        p = str(tmp_path / "wal.log")
+        with open(p, "wb") as f:
+            for rec in recs:
+                payload = pickle.dumps(rec, protocol=5)
+                f.write(struct.pack("<II", zlib.crc32(payload),
+                                    len(payload)) + payload)
+            f.write(b"\x01\x02\x03")  # torn mid-append legacy header
+        w = WAL(p)
+        assert list(w.records()) == recs
+        w.close()
+
 
 class TestTornTailVsMarker:
     def test_byte_exact_torn_tail_drops_only_last_write(self, tmp_path):
@@ -562,6 +609,63 @@ class TestReplicaCatchUp:
             assert ("node2", "closed") in states
             assert states.index(("node2", "open")) < \
                 states.index(("node2", "closed"))
+
+    def test_drain_is_per_index(self, tmp_path):
+        """Regression: drain() used to clear the WHOLE active set and a
+        single shared queue, so overlapping catch-up runs on different
+        indexes released each other's deferred writes mid-replay."""
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            for name in ("i", "j"):
+                c.coordinator.create_index(name)
+                c.coordinator.create_field(name, "f")
+            rm = c.nodes[2].enable_recovery()
+            rm.begin("i")
+            rm.begin("j")
+            assert c.nodes[2].import_bits("i", "f", rows=[1], cols=[2],
+                                          remote=True) == 0
+            assert c.nodes[2].import_bits("j", "f", rows=[3], cols=[4],
+                                          remote=True) == 0
+            assert rm.drain(["i"]) == 1  # only i's queue applies
+            assert not rm.active("i") and rm.active("j")
+            assert c.nodes[2].api.query("i", "Row(f=1)")[0].columns == [2]
+            assert c.nodes[2].api.query("j", "Row(f=3)")[0].columns == []
+            assert rm.drain() == 1  # bare drain still releases the rest
+            assert c.nodes[2].api.query("j", "Row(f=3)")[0].columns == [4]
+
+    def test_failed_catch_up_keeps_breaker_open(self, tmp_path):
+        """Regression: catch_up's finally used to gossip 'closed' even
+        when repair raised, so a still-lagging node advertised itself
+        caught up and peers routed reads back to stale data. Failure
+        must propagate and leave the breaker open; a retry that
+        completes closes it."""
+        with LocalCluster(3, replica_n=3, base_path=str(tmp_path)) as c:
+            c.enable_gossip()
+            rm = c.nodes[2].enable_recovery()
+            _lag_node2(c)
+            states = []
+            orig = c.nodes[2].gossip.record_breaker
+
+            def spy(node_id, state, **kw):
+                states.append((node_id, state))
+                return orig(node_id, state, **kw)
+
+            c.nodes[2].gossip.record_breaker = spy
+
+            def unreachable(index, origin, shards):
+                raise OSError("peer unreachable")
+
+            rm._repair_from = unreachable
+            with pytest.raises(OSError):
+                rm.catch_up()
+            assert ("node2", "open") in states
+            assert ("node2", "closed") not in states
+            del rm._repair_from  # retry with the real repair path
+            summary = rm.catch_up()
+            assert summary["shards"] > 0
+            assert ("node2", "closed") in states
+            sums = [n.api.checksum() for n in c.nodes]
+            assert sums[0] == sums[1] == sums[2]
 
     def test_recovery_endpoints_ship_snapshot_and_tail(self, tmp_path):
         """The transport itself: /internal/recovery/snapshot returns an
