@@ -24,6 +24,7 @@ Run on real TPU hardware by the round driver; also runs on CPU.
 import gc
 import json
 import os
+import random
 import statistics
 import subprocess
 import sys
@@ -819,6 +820,104 @@ def bench_config11(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 12 — distributed tracing overhead: off / sampled / always-on
+# ---------------------------------------------------------------------------
+
+def bench_config12(device: str) -> None:
+    """Tracing-plane overhead on the single-node query path. Four phases
+    over one fixed workload: untraced (the default NopTracer), tracing
+    configured-but-off, 10% head sampling, and always-on with the trace
+    store. Emits p50 per phase and overhead ratios vs untraced; HARD
+    asserts are correctness, not timing (CPU timing is too noisy to
+    gate): results stay bit-identical across phases, the disabled path
+    returns the one shared no-op span, and the off phase allocates ZERO
+    Span objects."""
+    from pilosa_tpu.api import API
+    from pilosa_tpu.obs import tracing as T
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(12)
+    api = API()
+    api.create_index("c12")
+    api.create_field("c12", "f")
+    per_shard = _n(40_000)
+    for shard in range(2):
+        rows = rng.integers(0, 8, per_shard)
+        cols = shard * SHARD_WIDTH + np.arange(per_shard)
+        api.import_bits("c12", "f", rows=rows.tolist(), cols=cols.tolist())
+    queries = ["Count(Row(f=3))", "Intersect(Row(f=1), Row(f=2))",
+               "TopN(f, n=4)"]
+
+    def workload() -> list:
+        return [api.query_json("c12", q) for q in queries]
+
+    prev = T.get_tracer()
+    phases = {}
+    results = {}
+    try:
+        # phase: untraced (the seed default — the comparison baseline)
+        T.set_tracer(T.NopTracer())
+        results["untraced"] = workload()
+        phases["untraced"] = _p50_ms(workload)
+
+        # phase: configured but off — must be allocation-free: count
+        # Span constructions across the whole phase
+        T.set_tracer(T.Tracer(enabled=False))
+        nop = T.get_tracer().start_span("probe")
+        assert nop is T.NOP_SPAN and nop is T.get_tracer().start_trace("p")
+        orig_init = T.Span.__init__
+        allocs = [0]
+
+        def counting_init(self, *a, **k):
+            allocs[0] += 1
+            orig_init(self, *a, **k)
+
+        T.Span.__init__ = counting_init
+        try:
+            results["off"] = workload()
+            phases["off"] = _p50_ms(workload)
+        finally:
+            T.Span.__init__ = orig_init
+        assert allocs[0] == 0, f"disabled tracing allocated {allocs[0]} spans"
+
+        # phase: 10% head sampling
+        T.set_tracer(T.Tracer(enabled=True, sample_rate=0.1,
+                              store=T.TraceStore(64),
+                              rng=random.Random(12)))
+        results["sampled"] = workload()
+        phases["sampled"] = _p50_ms(workload)
+
+        # phase: always-on, full span trees into the store
+        T.set_tracer(T.Tracer(enabled=True, sample_rate=1.0,
+                              store=T.TraceStore(64)))
+        results["always"] = workload()
+        phases["always"] = _p50_ms(workload)
+        stored = len(T.get_tracer().store)
+        assert stored > 0, "always-on tracing stored no traces"
+    finally:
+        T.set_tracer(prev)
+
+    for name in ("off", "sampled", "always"):
+        assert results[name] == results["untraced"], \
+            f"tracing phase {name!r} changed query results"
+
+    base = phases["untraced"]
+
+    def pct_over(name: str) -> float:
+        return (phases[name] / max(base, 1e-9) - 1.0) * 100.0
+
+    _emit(f"c12_tracing_always_on_p50{SCALED} ({device})",
+          phases["always"], "ms", base / max(phases["always"], 1e-9),
+          untraced_ms=base, off_ms=phases["off"],
+          sampled_ms=phases["sampled"],
+          off_overhead_pct=pct_over("off"),
+          sampled_overhead_pct=pct_over("sampled"),
+          always_overhead_pct=pct_over("always"),
+          spans_allocated_off=allocs[0], traces_stored=stored,
+          queries=len(queries))
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -970,6 +1069,7 @@ _CONFIGS = {
     "9": bench_config9,
     "10": bench_config10,
     "11": bench_config11,
+    "12": bench_config12,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -176,7 +176,9 @@ class API:
         text = pql if isinstance(pql, str) else "".join(
             c.to_pql() for c in getattr(pql, "calls", []))
         rec = self.history.begin(index, text, "pql")
-        span = get_tracer().start_span("executor.Execute", index=index)
+        span = get_tracer().start_trace("query.pql", index=index)
+        rec.trace_id = span.trace_id
+        span.set_tag("request_id", rec.request_id)
         t0 = _time.monotonic()
         try:
             parsed = parse(pql) if isinstance(pql, str) else pql
@@ -211,6 +213,8 @@ class API:
             raise
         finally:
             span.finish()
+            self._maybe_slow_log("pql", index, text,
+                                 _time.monotonic() - t0, rec)
 
     def sql(self, query: str, parsed=None):
         """Execute a SQL statement (reference: server/sql.go:17 execSQL).
@@ -225,6 +229,9 @@ class API:
             eng = self._sql_engine = SQLEngine(self)
         M.REGISTRY.count(M.METRIC_SQL_QUERIES)
         rec = self.history.begin("", query, "sql")
+        span = get_tracer().start_trace("query.sql")
+        rec.trace_id = span.trace_id
+        span.set_tag("request_id", rec.request_id)
         t0 = _time.monotonic()
         try:
             out = eng.query(query, parsed=parsed)
@@ -239,10 +246,37 @@ class API:
                 self.query_logger.log("sql", "", query,
                                       _time.monotonic() - t0, error=str(e))
             raise
+        finally:
+            span.finish()
+            self._maybe_slow_log("sql", "", query,
+                                 _time.monotonic() - t0, rec)
+
+    def _maybe_slow_log(self, kind: str, index: str, text: str,
+                        duration_s: float, rec) -> None:
+        """Structured slow-query line above the tracer's threshold,
+        linking request_id <-> trace_id (obs/tracing.py slow_ms)."""
+        tracer = get_tracer()
+        if tracer.slow_ms <= 0 or duration_s * 1e3 < tracer.slow_ms:
+            return
+        M.REGISTRY.count(M.METRIC_TRACE_SLOW_QUERIES, kind=kind)
+        if self.query_logger is not None:
+            self.query_logger.log(
+                "slow", index, text, duration_s,
+                trace_id=rec.trace_id, request_id=rec.request_id)
 
     def query_json(self, index: str, pql: str,
                    priority: Optional[str] = None,
-                   deadline_ms: Optional[float] = None) -> dict:
+                   deadline_ms: Optional[float] = None,
+                   profile: bool = False) -> dict:
+        """``profile=True`` forces a sampled trace for this query and
+        returns its span tree alongside the results (the reference's
+        ProfiledSpan surface)."""
+        if profile:
+            with get_tracer().profile("query.profile", index=index) as root:
+                out = self.query_json(index, pql, priority=priority,
+                                      deadline_ms=deadline_ms)
+            out["profile"] = root.to_json()
+            return out
         results = [result_to_json(r) for r in self.query(
             index, pql, priority=priority, deadline_ms=deadline_ms)]
         return {"results": results}

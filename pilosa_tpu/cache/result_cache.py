@@ -27,6 +27,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.obs.tracing import active_span, get_tracer
 
 try:  # cost model only; the cache itself is numpy-free
     import numpy as _np
@@ -138,10 +139,16 @@ class ResultCache:
             self.registry.observe_bucketed(
                 M.METRIC_CACHE_HIT_LATENCY, time.perf_counter() - t0,
                 M.CACHE_LATENCY_BUCKETS)
+            active_span().record("cache.lookup", time.perf_counter() - t0,
+                                 outcome="hit")
             return True, value
         if count_miss:
             self._misses += 1
             self.registry.count(M.METRIC_CACHE_MISSES)
+            # peek-style misses (count_miss=False) stay silent in the
+            # trace too — the authoritative dispatch-time lookup records
+            active_span().record("cache.lookup", time.perf_counter() - t0,
+                                 outcome="miss")
         return False, None
 
     def fetch(self, key: Tuple) -> Tuple[str, Any]:
@@ -177,6 +184,8 @@ class ResultCache:
             self.registry.count(M.METRIC_CACHE_MISSES)
         else:
             self.registry.count(M.METRIC_CACHE_SINGLEFLIGHT)
+        active_span().record("cache.lookup", time.perf_counter() - t0,
+                             outcome=outcome[0])
         return outcome
 
     def complete(self, key: Tuple, value: Any) -> None:
@@ -224,7 +233,9 @@ class ResultCache:
         if state == "hit":
             return payload
         if state == "follower":
-            return copy.deepcopy(payload.result())
+            with get_tracer().start_span("cache.single_flight_wait"):
+                value = payload.result()
+            return copy.deepcopy(value)
         t0 = time.perf_counter()
         try:
             value = compute()

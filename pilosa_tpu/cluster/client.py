@@ -22,6 +22,8 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
+from pilosa_tpu.obs.tracing import active_span, current_traceparent
+
 
 class NodeDownError(ConnectionError):
     """The peer did not answer at the transport level — retarget replicas."""
@@ -80,12 +82,23 @@ class InternalClient:
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
                 req.add_header("Content-Type", ctype)
+            # W3C-style trace propagation: every RPC made under a sampled
+            # span scope (query legs, hedges, retries, translate, SQL
+            # subtrees, recovery fetches) carries the context so the
+            # serving node's spans join the coordinator's trace.
+            tp = current_traceparent()
+            if tp is not None:
+                req.add_header("traceparent", tp)
+                if attempt:
+                    req.add_header("x-trace-attempt", str(attempt))
             try:
                 if self.fault_plan is not None and node_id is not None:
                     self.fault_plan.on_request(node_id, token=token, op=op)
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     data = resp.read()
-                    return json.loads(data) if data else {}
+                    out = json.loads(data) if data else {}
+                    self._apply_trace(out)
+                    return out
             except urllib.error.HTTPError as e:
                 msg = e.read().decode(errors="replace")
                 try:
@@ -143,6 +156,15 @@ class InternalClient:
             env = out.get("gossip")
             if isinstance(env, dict):
                 g.receive(env)
+
+    def _apply_trace(self, out) -> None:
+        """Graft the remote span tree a traced server piggybacked on its
+        response (the gossip-envelope pattern) under the calling span —
+        for query legs that is the cluster.leg span on this thread."""
+        if isinstance(out, dict):
+            sub = out.pop("trace", None)
+            if isinstance(sub, dict):
+                active_span().add_remote(sub)
 
     # -- query fan-out (reference: internal_client.go:602 QueryNode) -------
 

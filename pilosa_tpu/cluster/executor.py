@@ -17,10 +17,12 @@ inter-host DCN axis.
 
 from __future__ import annotations
 
+import contextvars
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from pilosa_tpu.cluster.client import InternalClient, NodeDownError
+from pilosa_tpu.obs.tracing import active_span, get_tracer
 from pilosa_tpu.cluster.topology import ClusterSnapshot, Node
 from pilosa_tpu.cluster.translator import ClusterTranslator
 from pilosa_tpu.core.holder import Holder
@@ -161,6 +163,7 @@ class ClusterExecutor:
                 veto = res.vetoed(
                     [nid for nid in by_node if nid != self.node_id])
                 if veto:
+                    active_span().set_tag("breaker_vetoed", sorted(veto))
                     try:
                         by_node = self._assign(snap, index, pending,
                                                dead | veto)
@@ -192,8 +195,18 @@ class ClusterExecutor:
                     mark_failed=mark_failed)
                 parts.extend(got)
             else:
+                def traced_leg(nid, s):
+                    with get_tracer().start_span("cluster.leg", node=nid,
+                                                 hedge=False,
+                                                 shards=len(s)):
+                        return run_remote(nodes[nid], s, None)
+
                 with ThreadPoolExecutor(max_workers=len(remote)) as pool:
-                    futs = {nid: pool.submit(run_remote, nodes[nid], s, None)
+                    # per-leg context copies re-enter the coordinator's
+                    # span scope on the pool workers (a shared Context
+                    # object cannot be entered concurrently)
+                    futs = {nid: pool.submit(contextvars.copy_context().run,
+                                             traced_leg, nid, s)
                             for nid, s in remote.items()}
                     if local_fn is not None:
                         parts.append(local_fn())

@@ -210,6 +210,8 @@ class ClusterNode:
               shards: Optional[Sequence[int]] = None,
               priority: Optional[str] = None,
               deadline_ms: Optional[float] = None) -> List[Any]:
+        from pilosa_tpu.obs.tracing import get_tracer
+
         q = parse(pql) if isinstance(pql, str) else pql
         is_write = any(c.name in _WRITE_CALLS for c in q.calls)
         self._check_state(write=is_write)
@@ -223,7 +225,8 @@ class ClusterNode:
                 time.monotonic() + deadline_ms / 1e3))
         else:
             ctx = contextlib.nullcontext()
-        with ctx:
+        with ctx, get_tracer().start_trace(
+                "query.pql", index=index, node=self.node.id):
             sched = self.executor.scheduler
             if sched is not None and not is_write:
                 # one admission ticket per client query; the per-shard
@@ -238,7 +241,17 @@ class ClusterNode:
 
     def query_json(self, index: str, pql: str,
                    priority: Optional[str] = None,
-                   deadline_ms: Optional[float] = None) -> dict:
+                   deadline_ms: Optional[float] = None,
+                   profile: bool = False) -> dict:
+        if profile:
+            from pilosa_tpu.obs.tracing import get_tracer
+
+            with get_tracer().profile("query.profile", index=index,
+                                      node=self.node.id) as root:
+                out = self.query_json(index, pql, priority=priority,
+                                      deadline_ms=deadline_ms)
+            out["profile"] = root.to_json()
+            return out
         return {"results": [result_to_json(r) for r in self.query(
             index, pql, priority=priority, deadline_ms=deadline_ms)]}
 
@@ -436,6 +449,7 @@ class ClusterNode:
     # this node's import methods (shard owners + replicas). Same
     # lazy-init as the single-node path — share the one implementation.
     sql = API.sql
+    _maybe_slow_log = API._maybe_slow_log
 
     @property
     def history(self):

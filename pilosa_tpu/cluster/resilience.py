@@ -41,6 +41,7 @@ the inter-host DCN axis that XLA collectives cannot hide (PAPERS.md
 
 from __future__ import annotations
 
+import contextvars
 import os
 import random
 import threading
@@ -52,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from pilosa_tpu.cluster.client import LegCancelled, NodeDownError
 from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.obs.tracing import get_tracer
 from pilosa_tpu.sched.clock import MonotonicClock
 from pilosa_tpu.sched.deadline import remaining_budget_s
 
@@ -437,7 +439,7 @@ class FaultPlan:
 
 class _Leg:
     __slots__ = ("node_id", "shards", "token", "t0", "fut", "is_hedge",
-                 "group", "done")
+                 "group", "done", "span")
 
     def __init__(self, node_id: str, shards: Tuple[int, ...],
                  token: CancellationToken, t0: float, is_hedge: bool,
@@ -450,6 +452,7 @@ class _Leg:
         self.is_hedge = is_hedge
         self.group = group
         self.done = False
+        self.span = None  # cluster.leg span, set by the pool worker
 
 
 class _LegGroup:
@@ -586,9 +589,24 @@ class Resilience:
             thread_name_prefix="pilosa-fanout")
 
         def submit(leg: _Leg) -> None:
+            # capture the submitting context (span scope AND deadline
+            # scope — the leg timeout was already budgeted pre-submit, so
+            # re-entering the full context changes no timing semantics)
+            # and re-enter it on the pool worker: the leg's span stays a
+            # child of the coordinator's query span across the thread hop
+            ctx = contextvars.copy_context()
+
+            def traced():
+                with get_tracer().start_span(
+                        "cluster.leg", node=leg.node_id,
+                        hedge=leg.is_hedge,
+                        shards=len(leg.shards)) as sp:
+                    leg.span = sp
+                    return run_remote(nodes[leg.node_id], list(leg.shards),
+                                      leg.token)
+
             def call():
-                return run_remote(nodes[leg.node_id], list(leg.shards),
-                                  leg.token)
+                return ctx.run(traced)
             leg.fut = pool.submit(call)
             active[leg.fut] = leg
 
@@ -623,6 +641,11 @@ class Resilience:
                 if not leg.done:
                     leg.token.cancel()
 
+        def tag_span(leg: Optional[_Leg], **tags) -> None:
+            if leg is not None and leg.span is not None:
+                for k, v in tags.items():
+                    leg.span.set_tag(k, v)
+
         def group_failed(g: _LegGroup) -> None:
             if not g.resolved:
                 g.resolved = True
@@ -636,6 +659,10 @@ class Resilience:
             if not leg.is_hedge:
                 g.resolved = True
                 parts.append(result)
+                if g.wave:
+                    tag_span(leg, hedge_won=True)
+                    for l in g.wave:
+                        tag_span(l, hedge_won=False)
                 cancel_wave(g)
                 return
             g.wave_parts[id(leg)] = result
@@ -643,6 +670,9 @@ class Resilience:
                 g.resolved = True
                 parts.extend(g.wave_parts[id(l)] for l in g.wave)
                 self.registry.count(obs_metrics.METRIC_CLUSTER_HEDGE_WINS)
+                for l in g.wave:
+                    tag_span(l, hedge_won=True)
+                tag_span(g.primary, hedge_won=False)
                 if g.primary is not None and not g.primary.done:
                     g.primary.token.cancel()
 
@@ -699,6 +729,7 @@ class Resilience:
                 leg.token.cancel()
                 self.registry.count(obs_metrics.METRIC_CLUSTER_LEG_TIMEOUTS,
                                     node=leg.node_id)
+                tag_span(leg, timeout=True)
                 leg_failure(leg, transport=False)
 
         if local_fn is not None:

@@ -91,6 +91,15 @@ class Config:
     auth_allowed_networks: List[str] = dataclasses.field(default_factory=list)
     # observability
     tracing_enable: bool = False
+    # distributed tracing ([obs.tracing] section / PILOSA_TPU_TRACE_*):
+    # contextvar span scopes + traceparent propagation (obs/tracing.py;
+    # install via obs.tracing.configure(cfg)). sample-rate head-samples
+    # roots; slow-ms > 0 writes a structured slow-query line linking
+    # request_id <-> trace_id; store-capacity bounds /internal/traces
+    trace_enabled: bool = False
+    trace_sample_rate: float = 1.0
+    trace_slow_ms: float = 0.0  # <=0: slow-query log off
+    trace_store_capacity: int = 256
     log_level: str = "info"
     log_path: str = ""
     query_log_path: str = ""  # reference: server.go:792 query logger
@@ -221,6 +230,12 @@ class Config:
                     flat[key] = v
 
         _flatten("", doc)
+        # [obs.tracing] keys land as obs_tracing_*; the fields are named
+        # trace_* so their env vars read PILOSA_TPU_TRACE_* (the
+        # documented dialect) — remap the TOML spelling onto them
+        for k in list(flat):
+            if k.startswith("obs_tracing_"):
+                flat["trace_" + k[len("obs_tracing_"):]] = flat.pop(k)
         return flat
 
     @classmethod

@@ -1,15 +1,24 @@
 """Observability: metrics, tracing, query history.
 
 Reference: metrics.go (prometheus registry, ~70 series), tracing/
-(Tracer/Span facade + nested query profiles), tracker.go + systemlayer/
-(query-history ring exposed as /query-history and SQL system tables).
+(Tracer/Span facade + nested query profiles, grown here into a
+contextvar-scoped distributed tracer with traceparent propagation),
+tracker.go + systemlayer/ (query-history ring exposed as /query-history
+and SQL system tables).
 """
 
 from pilosa_tpu.obs.history import ExecutionRecord, ExecutionRequestsAPI
 from pilosa_tpu.obs.metrics import REGISTRY, MetricsRegistry
-from pilosa_tpu.obs.tracing import NopTracer, Span, Tracer, get_tracer, set_tracer
+from pilosa_tpu.obs.tracing import (
+    NOP_SPAN, NopTracer, Span, TraceStore, Tracer, active_span, configure,
+    current_span, current_traceparent, format_traceparent, get_tracer,
+    parse_traceparent, set_tracer, span_scope,
+)
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "Tracer", "NopTracer", "Span",
-    "get_tracer", "set_tracer", "ExecutionRecord", "ExecutionRequestsAPI",
+    "TraceStore", "NOP_SPAN", "get_tracer", "set_tracer", "configure",
+    "current_span", "active_span", "current_traceparent", "span_scope",
+    "format_traceparent", "parse_traceparent",
+    "ExecutionRecord", "ExecutionRequestsAPI",
 ]

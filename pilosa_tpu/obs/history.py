@@ -24,6 +24,7 @@ class ExecutionRecord:
     runtime_ns: int = 0
     status: str = "running"
     error: str = ""
+    trace_id: str = ""  # links /query-history to /internal/traces/{id}
 
     def to_json(self) -> dict:
         return {
@@ -35,6 +36,7 @@ class ExecutionRecord:
             "runtimeNs": self.runtime_ns,
             "status": self.status,
             "error": self.error,
+            "traceID": self.trace_id,
         }
 
 

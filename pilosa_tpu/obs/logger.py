@@ -72,14 +72,19 @@ class QueryLogger:
             os.makedirs(d, exist_ok=True)
 
     def log(self, kind: str, index: str, query: str, duration_s: float,
-            error: Optional[str] = None) -> None:
+            error: Optional[str] = None, trace_id: str = "",
+            request_id: str = "") -> None:
         rec = {
             "ts": time.time(),
-            "kind": kind,  # pql | sql
+            "kind": kind,  # pql | sql | slow
             "index": index,
             "query": query[:4096],
             "duration_ms": round(duration_s * 1e3, 3),
         }
+        if trace_id:
+            rec["traceID"] = trace_id
+        if request_id:
+            rec["requestID"] = request_id
         if error:
             rec["error"] = str(error)[:1024]
         line = json.dumps(rec, separators=(",", ":")) + "\n"

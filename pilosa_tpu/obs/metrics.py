@@ -128,6 +128,23 @@ METRIC_RECOVERY_CATCHUP_LAG_MS = "recovery_catchup_lag_ms"  # histogram
 # fat tail spans seconds
 RECOVERY_CATCHUP_LAG_BUCKETS_MS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
                                    1000.0, 5000.0, 30000.0)
+# distributed tracing (obs/tracing.py): sampled roots started/finished,
+# roots skipped by head sampling, remote spans adopted from a peer's
+# traceparent, trace-store evictions, root-trace wall time and per-stage
+# latencies (labelled stage=<span name> — the dispatch-floor breakdown)
+METRIC_TRACE_STARTED = "trace_started_total"
+METRIC_TRACE_FINISHED = "trace_finished_total"
+METRIC_TRACE_UNSAMPLED = "trace_unsampled_total"
+METRIC_TRACE_REMOTE_SPANS = "trace_remote_spans_total"
+METRIC_TRACE_STORE_DROPPED = "trace_store_dropped_total"
+METRIC_TRACE_SLOW_QUERIES = "trace_slow_queries_total"
+METRIC_TRACE_DURATION = "trace_duration_ms"  # histogram
+METRIC_TRACE_STAGE_LATENCY = "trace_stage_latency_ms"  # histogram
+# sub-ms cache hits up through the ~67ms dispatch floor and slow remote
+# fan-outs — one layout for both the root and per-stage histograms so
+# a stage's share of the root is readable bucket-for-bucket
+TRACE_DURATION_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                             250.0, 500.0, 1000.0, 5000.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

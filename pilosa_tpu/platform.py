@@ -111,18 +111,28 @@ def dispatch_guard():
 def guarded_call(fn):
     """Wrap a compiled/jitted callable so every invocation holds the
     dispatch guard (the leaf-lock rule above). Decorate below ``jax.jit``
-    so the lock spans trace+launch of one call, not the cache."""
+    so the lock spans trace+launch of one call, not the cache.
+
+    Traced queries see the async-dispatch split here: ``device.dispatch``
+    is the launch (trace+enqueue), ``device.block_until_ready`` the
+    device-side wait. Span bookkeeping is pure in-memory appends, so it
+    respects the leaf-lock rule (no I/O under the dispatch lock)."""
     import functools
+
+    from pilosa_tpu.obs.tracing import get_tracer
 
     @functools.wraps(fn)
     def call(*args, **kwargs):
         guard = dispatch_guard()
+        tracer = get_tracer()
         with guard:
-            out = fn(*args, **kwargs)
+            with tracer.start_span("device.dispatch"):
+                out = fn(*args, **kwargs)
             if guard is _DISPATCH_LOCK:
                 import jax
 
-                jax.block_until_ready(out)
+                with tracer.start_span("device.block_until_ready"):
+                    jax.block_until_ready(out)
             return out
 
     call.__wrapped__ = fn

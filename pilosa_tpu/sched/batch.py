@@ -19,9 +19,11 @@ Count never waits behind a 100-row Extract scan.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from pilosa_tpu.cache.keys import shard_key
+from pilosa_tpu.obs.tracing import NOP_SPAN, get_tracer, span_scope
 from pilosa_tpu.pql.ast import Call, Query, unwrap_options
 
 # Top-level call name -> op family. Families batch together; anything
@@ -114,39 +116,56 @@ def execute_batch(executor, entries: List) -> None:
         for e in entries:
             _run_single(executor, e)
         return
+    t0 = time.perf_counter()
     try:
-        if hetero:
-            # cross-shard-set fusion: one dispatch over the union
-            # layout, each query masked to its own subset
-            per_query = many(first.index, [e.query for e in entries],
-                             per_query_shards=[e.shards for e in entries])
-        elif many is not None:
-            # native fusion primitive (pql/executor.py execute_many):
-            # per-query call lists stay intact, one blocking sync
-            per_query = many(first.index, [e.query for e in entries],
-                             shards=first.shards)
-        else:
-            # plain executors: concatenate calls into one merged Query
-            # and scatter by offset span
-            calls: List[Call] = []
-            spans: List[Tuple[int, int]] = []
-            for e in entries:
-                spans.append((len(calls), len(e.query.calls)))
-                calls.extend(e.query.calls)
-            results = executor.execute(first.index, Query(calls),
-                                       shards=first.shards)
-            per_query = [results[off:off + n] for off, n in spans]
+        # the fused dispatch runs under the head entry's span scope —
+        # device spans land on the query that "paid" for the dispatch;
+        # every batch-mate gets a post-hoc sched.fuse record below
+        with span_scope(_entry_span(first)), \
+                get_tracer().start_span("sched.fuse", fused=len(entries)):
+            if hetero:
+                # cross-shard-set fusion: one dispatch over the union
+                # layout, each query masked to its own subset
+                per_query = many(first.index, [e.query for e in entries],
+                                 per_query_shards=[e.shards for e in entries])
+            elif many is not None:
+                # native fusion primitive (pql/executor.py execute_many):
+                # per-query call lists stay intact, one blocking sync
+                per_query = many(first.index, [e.query for e in entries],
+                                 shards=first.shards)
+            else:
+                # plain executors: concatenate calls into one merged Query
+                # and scatter by offset span
+                calls: List[Call] = []
+                spans: List[Tuple[int, int]] = []
+                for e in entries:
+                    spans.append((len(calls), len(e.query.calls)))
+                    calls.extend(e.query.calls)
+                results = executor.execute(first.index, Query(calls),
+                                           shards=first.shards)
+                per_query = [results[off:off + n] for off, n in spans]
     except Exception:
         for e in entries:
             _run_single(executor, e)
         return
+    fuse_s = time.perf_counter() - t0
     for e, res in zip(entries, per_query):
+        if e is not first:
+            _entry_span(e).record("sched.fuse", fuse_s, fused=len(entries))
         e.future.set_result(res)
+
+
+def _entry_span(entry):
+    # entries normally carry the submitter's span (sched/scheduler.py
+    # _Pending), but batch tests construct bare entry objects
+    return getattr(entry, "span", None) or NOP_SPAN
 
 
 def _run_single(executor, entry) -> None:
     try:
-        entry.future.set_result(
-            executor.execute(entry.index, entry.query, shards=entry.shards))
+        with span_scope(_entry_span(entry)):
+            res = executor.execute(entry.index, entry.query,
+                                   shards=entry.shards)
+        entry.future.set_result(res)
     except Exception as exc:
         entry.future.set_exception(exc)

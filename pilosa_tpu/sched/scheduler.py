@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence, Union
 
 from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
 from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.obs.tracing import active_span
 from pilosa_tpu.pql.ast import Call, Query
 from pilosa_tpu.pql.executor import has_write_calls, query_maskable
 from pilosa_tpu.pql.parser import parse
@@ -34,7 +35,7 @@ _PRIORITY_RANK = {PRIORITY_INTERACTIVE: 0, PRIORITY_BATCH: 1}
 
 class _Pending:
     __slots__ = ("index", "query", "shards", "priority", "rank", "deadline",
-                 "future", "enqueued", "seq", "key", "fusible")
+                 "future", "enqueued", "seq", "key", "fusible", "span")
 
     def __init__(self, index: str, query: Query,
                  shards: Optional[Sequence[int]], priority: str,
@@ -54,6 +55,9 @@ class _Pending:
         self.fusible = (self.key.shards is not None
                         and fusible_family(self.key.family)
                         and query_maskable(query))
+        # the submitter's trace scope, captured at the pool boundary so
+        # the dispatch worker can restore parentage (obs/tracing.py)
+        self.span = active_span()
 
 
 class _Resolved:
@@ -142,6 +146,7 @@ class QueryScheduler:
         self.clock.attach(self._cv)
         self._queue: List[_Pending] = []
         self._seq = 0
+        self._claim_window_s = 0.0
         self._paused = False
         self._closed = False
         self._inflight_admits = 0
@@ -362,6 +367,10 @@ class QueryScheduler:
             if not ripe:
                 self.clock.wait(self._cv, head.enqueued + window_s - now)
                 continue
+            # coalescing share of each claimed entry's queue wait (the
+            # head paid up to the full window; later arrivals less)
+            self._claim_window_s = min(max(0.0, now - head.enqueued),
+                                       window_s)
             return self._take_locked(head.key, now)
 
     def _claim_locked(self, p: _Pending, now: float,
@@ -378,8 +387,12 @@ class QueryScheduler:
                 f"deadline exceeded after "
                 f"{(now - p.enqueued) * 1e3:.1f} ms in queue"))
             return
-        self.registry.observe(obs_metrics.METRIC_SCHED_BATCH_WAIT,
-                              now - p.enqueued)
+        wait = now - p.enqueued
+        self.registry.observe(obs_metrics.METRIC_SCHED_BATCH_WAIT, wait)
+        p.span.record("sched.queue_wait", wait, priority=p.priority)
+        window = min(wait, self._claim_window_s)
+        if window > 0:
+            p.span.record("sched.batch_window", window)
         batch.append(p)
 
     def _take_locked(self, key: GroupKey, now: float) -> List[_Pending]:

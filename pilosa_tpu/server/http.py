@@ -128,6 +128,10 @@ _ROUTES = [
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
     ("GET", re.compile(r"^/query-history$"), "get_query_history"),
+    # distributed traces (obs/tracing.py TraceStore): summaries + one
+    # assembled span tree per trace id
+    ("GET", re.compile(r"^/internal/traces$"), "get_internal_traces"),
+    ("GET", re.compile(r"^/internal/traces/([^/]+)$"), "get_internal_trace"),
     ("GET", re.compile(r"^/index/([^/]+)/mutex-check$"), "get_mutex_check"),
     # DAX directive push (reference: dax computer /directive endpoint)
     ("POST", re.compile(r"^/directive$"), "post_directive"),
@@ -193,7 +197,21 @@ class Handler(BaseHTTPRequestHandler):
             raise ValueError(f"request body missing required key {key!r}")
         return body[key]
 
+    #: remote rpc span for the in-flight request (set by _dispatch when
+    #: the caller sent a sampled traceparent header)
+    _trace_span = None
+
     def _send(self, code: int, payload: dict) -> None:
+        sp = self._trace_span
+        if sp is not None:
+            # ship the serving node's finished span tree back to the
+            # caller piggybacked on the response (the gossip-envelope
+            # pattern); the client grafts it under its leg span
+            self._trace_span = None
+            sp.finish()
+            if isinstance(payload, dict):
+                payload = dict(payload)
+                payload["trace"] = sp.to_json()
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -252,6 +270,21 @@ class Handler(BaseHTTPRequestHandler):
                 continue
             match = pattern.match(self.path.split("?", 1)[0])
             if match:
+                tp = self.headers.get("traceparent")
+                if tp:
+                    # join the caller's trace: every handler under this
+                    # scope (query legs, translate, sql subtrees,
+                    # recovery fetches) nests its spans below rpc.<route>
+                    from pilosa_tpu.obs.tracing import get_tracer
+
+                    span = get_tracer().start_remote(
+                        f"rpc.{name}", tp,
+                        node=getattr(getattr(self.api, "node", None),
+                                     "id", ""))
+                    attempt = self.headers.get("x-trace-attempt")
+                    if attempt and span.recording:
+                        span.set_tag("attempt", attempt)
+                    self._trace_span = span if span.recording else None
                 try:
                     if self.auth is not None and name not in _AUTH_EXEMPT:
                         self._check_auth(name, match)
@@ -274,6 +307,13 @@ class Handler(BaseHTTPRequestHandler):
                     self._send(408, {"error": str(e)})
                 except Exception as e:  # pragma: no cover - last resort
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    # a span _send never consumed (handler wrote its own
+                    # response) must still finish, or its scope would
+                    # leak into the next keep-alive request
+                    sp, self._trace_span = self._trace_span, None
+                    if sp is not None:
+                        sp.finish()
                 return
         self._send(404, {"error": f"no route for {method} {self.path}"})
 
@@ -315,24 +355,12 @@ class Handler(BaseHTTPRequestHandler):
         if qs.get("timeout_ms"):
             kw["deadline_ms"] = float(qs["timeout_ms"][-1])
         if qs.get("profile", [""])[-1].lower() == "true":
-            # per-query CPU profile (reference: http_handler.go:1301
-            # DoPerQueryProfiling); top functions ride in the response
-            import cProfile
-            import io as _io
-            import pstats
-
-            prof = cProfile.Profile()
-            prof.enable()
-            try:
-                out = self.api.query_json(index, q, **kw)
-            finally:
-                prof.disable()
-            s = _io.StringIO()
-            pstats.Stats(prof, stream=s).sort_stats("cumulative") \
-                .print_stats(25)
-            out["profile"] = s.getvalue().splitlines()
-            self._send(200, out)
-            return
+            # per-query latency attribution (reference: http_handler.go
+            # :1301 DoPerQueryProfiling): the response carries the full
+            # span tree — queue wait, cache, device dispatch/sync, remote
+            # legs — even when tracing is globally off (forced root).
+            # Process-wide CPU profiles stay on /cpu-profile/start|stop.
+            kw["profile"] = True
         self._send(200, self.api.query_json(index, q, **kw))
 
     def post_sql(self):
@@ -343,6 +371,19 @@ class Handler(BaseHTTPRequestHandler):
         parsed = None
         if self.auth is not None:
             parsed = self._authorize_sql(text)
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(self.path).query)
+        if qs.get("profile", [""])[-1].lower() == "true":
+            # same span-tree surface as /index/{i}/query?profile=true
+            from pilosa_tpu.obs.tracing import get_tracer
+
+            with get_tracer().profile("sql.profile") as root:
+                res = self.api.sql(text, parsed=parsed)
+            out = res.to_json()
+            out["profile"] = root.to_json()
+            self._send(200, out)
+            return
         self._send(200, self.api.sql(text, parsed=parsed).to_json())
 
     def _authorize_sql(self, text: str):
@@ -529,6 +570,23 @@ class Handler(BaseHTTPRequestHandler):
 
     def get_query_history(self):
         self._send(200, [r.to_json() for r in self.api.history.list()])
+
+    def get_internal_traces(self):
+        """Newest-first summaries of finished traces (the span trees stay
+        behind /internal/traces/{id})."""
+        from pilosa_tpu.obs.tracing import get_tracer
+
+        store = get_tracer().store
+        self._send(200, {"enabled": store is not None,
+                         "traces": store.list() if store is not None else []})
+
+    def get_internal_trace(self, trace_id: str):
+        from pilosa_tpu.obs.tracing import get_tracer
+
+        store = get_tracer().store
+        if store is None:
+            raise KeyError("trace store disabled (enable [obs.tracing])")
+        self._send(200, store.get(trace_id))  # KeyError -> 404
 
     def get_mutex_check(self, index: str):
         from pilosa_tpu.server.maintenance import mutex_check

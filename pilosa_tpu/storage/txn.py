@@ -63,10 +63,13 @@ class Qcx:
         if self._done:
             return self.lsn
         self._done = True
+        from pilosa_tpu.obs.tracing import get_tracer
+
         try:
-            self.holder.flush_wals()
-            self.lsn = self.holder.last_lsn()
-            self.holder.maybe_checkpoint()
+            with get_tracer().start_span("storage.wal.commit"):
+                self.holder.flush_wals()
+                self.lsn = self.holder.last_lsn()
+                self.holder.maybe_checkpoint()
         finally:
             _WRITE_CTX.depth -= 1
             self.holder.write_lock.release()

@@ -51,6 +51,16 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== tracing lane (PILOSA_TPU_TRACE=1, sample rate 1.0) =="
+# Every query in these suites runs under a live always-sampling tracer:
+# results must stay bit-identical to the untraced runs above, and the
+# conftest span-leak fixture asserts the context scope is empty after
+# each test (a leaked span would silently re-parent later traces).
+PILOSA_TPU_TRACE=1 PILOSA_TPU_TRACE_SAMPLE_RATE=1.0 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_sched.py tests/test_cluster.py \
+    tests/test_cache.py tests/test_tracing.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

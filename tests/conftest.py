@@ -37,3 +37,19 @@ def _budget_leak_audit():
     from pilosa_tpu.core import stacked as _stx
 
     _stx.BUDGET.audit()
+
+
+@pytest.fixture(autouse=True)
+def _span_leak_audit():
+    """Tracing-lane leak check (scripts/tier1.sh sets PILOSA_TPU_TRACE=1):
+    after every test the main thread's span scope must be empty — a span
+    left unfinished would silently re-parent every later trace in the
+    process."""
+    yield
+    if not os.environ.get("PILOSA_TPU_TRACE"):
+        return
+    from pilosa_tpu.obs.tracing import current_span
+
+    leaked = current_span()
+    assert leaked is None, \
+        f"span {leaked.name!r} leaked out of the test's trace scope"

@@ -1,0 +1,121 @@
+"""Query-history ring (obs/history.py): eviction at capacity, concurrent
+begin/end safety, error records, and the request_id <-> trace_id linkage
+surfaced through to_json and the /query-history endpoint.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.obs.history import ExecutionRequestsAPI
+from pilosa_tpu.server import serve
+
+
+class TestRing:
+    def test_eviction_at_capacity(self):
+        h = ExecutionRequestsAPI(capacity=5)
+        recs = [h.begin("i", f"q{n}", "pql") for n in range(8)]
+        got = h.list()
+        assert len(got) == 5
+        assert [r.query for r in got] == ["q7", "q6", "q5", "q4", "q3"]
+        assert h.get(recs[0].request_id) is None  # evicted
+        assert h.get(recs[-1].request_id).query == "q7"
+
+    def test_end_sets_status_and_runtime(self):
+        h = ExecutionRequestsAPI()
+        rec = h.begin("i", "q", "pql")
+        assert rec.status == "running" and rec.runtime_ns == 0
+        h.end(rec)
+        assert rec.status == "complete"
+        assert rec.runtime_ns >= 0 and rec.error == ""
+
+    def test_error_records(self):
+        h = ExecutionRequestsAPI()
+        rec = h.begin("i", "Bad(", "pql")
+        h.end(rec, error="parse error")
+        got = h.get(rec.request_id)
+        assert got.status == "error"
+        assert got.error == "parse error"
+
+    def test_list_returns_copies_not_live_records(self):
+        h = ExecutionRequestsAPI()
+        rec = h.begin("i", "q", "pql")
+        snap = h.list()[0]
+        h.end(rec, error="late")
+        assert snap.status == "running"  # the copy is a point-in-time view
+
+    def test_concurrent_begin_end(self):
+        h = ExecutionRequestsAPI(capacity=64)
+        errors = []
+
+        def worker(n):
+            try:
+                for k in range(50):
+                    rec = h.begin("i", f"q{n}.{k}", "pql")
+                    h.end(rec, error="x" if k % 7 == 0 else None)
+                    h.list()
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        got = h.list()
+        assert len(got) == 64
+        assert all(r.status in ("complete", "error") for r in got)
+
+    def test_to_json_carries_trace_id(self):
+        h = ExecutionRequestsAPI()
+        rec = h.begin("i", "Count(Row(f=1))", "pql")
+        rec.trace_id = "ab" * 16
+        h.end(rec)
+        doc = h.get(rec.request_id).to_json()
+        assert doc["traceID"] == "ab" * 16
+        assert doc["requestID"] == rec.request_id
+        assert doc["status"] == "complete"
+        assert set(doc) == {"requestID", "index", "query", "language",
+                            "startTime", "runtimeNs", "status", "error",
+                            "traceID"}
+
+
+class TestQueryHistoryEndpoint:
+    @pytest.fixture
+    def server(self):
+        api = API()
+        srv, _ = serve(api, port=0, background=True)
+        yield api, f"http://127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+
+    def test_history_links_traces_over_http(self, server):
+        from pilosa_tpu.obs import tracing as T
+
+        api, base = server
+        prev = T.get_tracer()
+        T.set_tracer(T.Tracer(enabled=True, store=T.TraceStore(16)))
+        try:
+            api.create_index("h")
+            api.create_field("h", "f")
+            api.query("h", "Set(1, f=2)")
+            api.query("h", "Count(Row(f=2))")
+            with pytest.raises(Exception):
+                api.query("h", "Count(Row(")  # parse error -> error record
+            with urllib.request.urlopen(base + "/query-history") as r:
+                docs = json.loads(r.read())
+            assert len(docs) == 3
+            assert docs[0]["status"] == "error" and docs[0]["error"]
+            ok = [d for d in docs if d["status"] == "complete"]
+            assert len(ok) == 2
+            for d in ok:
+                # every completed query's trace is fetchable by the id
+                # the history row carries
+                assert d["traceID"]
+                assert T.get_tracer().store.get(d["traceID"])["spans"]
+        finally:
+            T.set_tracer(prev)

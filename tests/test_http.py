@@ -297,7 +297,13 @@ class TestSurfaceCompletion:
         with urllib.request.urlopen(req) as r:
             out = json.loads(r.read())
         assert out["results"] == [2]
-        assert any("cumulative" in line for line in out["profile"])
+        # profile=true returns the query's span tree (latency
+        # attribution), not a CPU profile (that's /cpu-profile/start|stop)
+        prof = out["profile"]
+        assert prof["name"] == "query.profile"
+        assert prof["duration_ns"] > 0
+        names = {c["name"] for c in prof["children"]}
+        assert "query.pql" in names
 
 
 class TestRouteSurfaceTail:

@@ -140,7 +140,9 @@ class TestMetrics:
 class TestTracing:
     def test_span_tree(self):
         t = Tracer()
-        with t.start_span("root") as root:
+        # roots are explicit now (start_trace); start_span outside any
+        # trace is a NOP so background work never creates stray traces
+        with t.start_trace("root") as root:
             with t.start_span("child", shard=3):
                 pass
             with t.start_span("child2"):
