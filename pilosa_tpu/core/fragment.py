@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-from pilosa_tpu import native
+from pilosa_tpu import native, platform
 from pilosa_tpu.ops import bsi as bsiops
 from pilosa_tpu.ops.bitmap import bits_to_plane
 from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
@@ -373,7 +373,8 @@ class SetFragment:
         """Upload-once view of all plane slots ``uint32[capacity, W]``
         (slots beyond len(row_ids) are zero padding)."""
         if self._device is None or self._device_version != self.version:
-            self._device = jax.device_put(self.planes)
+            # traced staging: a device.h2d_copy span attributes the cost
+            self._device = platform.h2d_copy(self.planes)
             self._device_version = self.version
         return self._device
 
@@ -498,6 +499,6 @@ class BSIFragment:
 
     def device_planes(self) -> jax.Array:
         if self._device is None or self._device_version != self.version:
-            self._device = jax.device_put(self.planes)
+            self._device = platform.h2d_copy(self.planes)
             self._device_version = self.version
         return self._device

@@ -339,6 +339,47 @@ class Holder:
             field.clear_columns(shard, unpack_plane(packed, WORDS_PER_SHARD))
         # unknown ops from a newer version are skipped (forward compat)
 
+    # -- device residency (core/stacked.py) -------------------------------------
+
+    def prewarm(self, index: Optional[str] = None) -> Dict[str, int]:
+        """Build and pin the stacked device planes for every (field,
+        view) up front, so the first query of each family runs warm —
+        no ``stack.build`` / ``device.h2d_copy`` on the serving path.
+        Returns {"set_stacks": n, "bsi_stacks": n}. Stacks land in the
+        field caches under the global DeviceBudget: prewarming more
+        than the budget holds simply LRU-evicts the coldest, identical
+        to demand paging."""
+        from pilosa_tpu.core.stacked import stacked_bsi, stacked_set
+
+        indexes = ([self.index(index)] if index is not None
+                   else list(self.indexes.values()))
+        sets = bsis = 0
+        for idx in indexes:
+            shard_list = sorted(idx.shards())
+            if not shard_list:
+                continue
+            for field in idx.fields.values():
+                for view in sorted(field.views):
+                    stacked_set(field, shard_list, view)
+                    sets += 1
+                if field.bsi:
+                    stacked_bsi(field, shard_list)
+                    bsis += 1
+        return {"set_stacks": sets, "bsi_stacks": bsis}
+
+    def residency_stats(self) -> Dict[str, float]:
+        """Current device-residency accounting (mirrors the
+        device_hbm_resident_bytes gauge plus budget capacity)."""
+        from pilosa_tpu.core.stacked import BUDGET, PAGING_STATS
+
+        return {
+            "resident_bytes": BUDGET.used,
+            "budget_bytes": BUDGET.cap,
+            "evictions": PAGING_STATS["evictions"],
+            "block_builds": PAGING_STATS["block_builds"],
+            "stale_retries": PAGING_STATS["stale_retries"],
+        }
+
     def schema(self) -> List[dict]:
         """JSON-facing schema (reference: api.go Schema / schema.go:502)."""
         return [

@@ -115,9 +115,12 @@ def _pow2(n: int) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Device-memory budget: LRU over paged blocks (the unbounded dimension).
-# Unpaged stacks stay bounded by the per-group subset LRU below plus the
-# paging threshold itself (an unpaged stack is at most one block large).
+# Device-memory budget: LRU over ALL resident stacked planes. Paged blocks,
+# unpaged single-block stacks, and BSI plane stacks are each a charged
+# entry — the budget is the full accounting of the device-residency plane,
+# and `device_hbm_resident_bytes` mirrors it. An evicted resident block is
+# lazily rebuilt on next touch with the same version check paged blocks
+# always had (a write since the snapshot -> StackStale -> executor retry).
 # ---------------------------------------------------------------------------
 
 def _env_mb(name: str, default_mb: int) -> int:
@@ -125,6 +128,19 @@ def _env_mb(name: str, default_mb: int) -> int:
         return int(os.environ.get(name, default_mb))
     except ValueError:
         return default_mb
+
+
+def _budget_bytes() -> int:
+    """HBM budget in bytes. ``PILOSA_TPU_DEVICE_BUDGET`` (bytes — the CI
+    clamp knob, precise enough to force paging on tiny test data) wins
+    over ``PILOSA_TPU_HBM_BUDGET_MB``."""
+    raw = os.environ.get("PILOSA_TPU_DEVICE_BUDGET")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return _env_mb("PILOSA_TPU_HBM_BUDGET_MB", 6144) << 20
 
 
 class DeviceBudget:
@@ -142,6 +158,8 @@ class DeviceBudget:
         self._lru: "OrderedDict[Tuple, Tuple[int, object]]" = OrderedDict()
 
     def charge(self, key: Tuple, nbytes: int, evict_cb) -> None:
+        from pilosa_tpu.obs import metrics as M
+
         with self._lock:
             old = self._lru.pop(key, None)
             if old is not None:
@@ -158,7 +176,9 @@ class DeviceBudget:
                     continue
                 self.used -= b
                 PAGING_STATS["evictions"] += 1
+                M.REGISTRY.count(M.METRIC_DEVICE_STACK_EVICTIONS)
                 cb()
+            M.REGISTRY.gauge(M.METRIC_DEVICE_HBM_RESIDENT_BYTES, self.used)
 
     def touch(self, key: Tuple) -> None:
         with self._lock:
@@ -170,6 +190,10 @@ class DeviceBudget:
             old = self._lru.pop(key, None)
             if old is not None:
                 self.used -= old[0]
+                from pilosa_tpu.obs import metrics as M
+
+                M.REGISTRY.gauge(M.METRIC_DEVICE_HBM_RESIDENT_BYTES,
+                                 self.used)
 
     def audit(self) -> None:
         """Accounting invariants (the testhook auditor analog,
@@ -182,9 +206,9 @@ class DeviceBudget:
                 f"DeviceBudget drift: used={self.used} entries={total}")
 
 
-#: Default HBM budget for paged blocks (v5e has 16 GiB; leave headroom
-#: for unpaged stacks, kernel workspace and XLA constants).
-BUDGET = DeviceBudget(_env_mb("PILOSA_TPU_HBM_BUDGET_MB", 6144) << 20)
+#: Default HBM budget for resident stacked planes (v5e has 16 GiB; leave
+#: headroom for kernel workspace and XLA constants).
+BUDGET = DeviceBudget(_budget_bytes())
 
 #: Target bytes per row block. A stack pages when its full tensor would
 #: exceed one block. Tests override via env to exercise paging cheaply.
@@ -235,13 +259,19 @@ class StackedSet:
         self._blocks: List[Optional[jax.Array]] = (
             [None] * (self.cap // self.block_rows))
         self._lock = threading.Lock()
-        self._zero: Optional[jax.Array] = None
         # request-scoped stacks (built inside a write Qcx, never
         # published to the field cache) opt out of budget accounting —
         # they die with the request, and LRU entries would orphan
         self.ephemeral = False
         if not self.paged:
-            self._blocks[0] = self._build_block_host(0)
+            # unpaged stacks are resident (pinned until LRU-evicted)
+            # and charged like any block, so BUDGET is the complete
+            # accounting of device-resident planes; an evicted block 0
+            # lazily rebuilds with the usual version check.
+            blk = self._build_block_host(0)
+            self._blocks[0] = blk
+            BUDGET.charge((self.serial, 0), blk.nbytes,
+                          lambda s=self: s._drop_block(0))
 
     # -- block machinery ----------------------------------------------------
 
@@ -253,20 +283,30 @@ class StackedSet:
         """Assemble block ``bi`` from the host fragment planes and upload.
         Caller must have validated the version snapshot (or hold the
         writer lock through the build, as __init__/advance do)."""
+        from pilosa_tpu.obs.tracing import get_tracer
+
         lo_slot = bi * self.block_rows
         hi_slot = min(lo_slot + self.block_rows, len(self.row_ids))
-        host = np.zeros((self.block_rows, self.total_words), dtype=np.uint32)
-        for si, frag in enumerate(self._fragments):
-            if frag is None:
-                continue
-            lo = si * self.words
-            for slot in range(lo_slot, hi_slot):
-                fslot = frag.row_index.get(self.row_ids[slot])
-                if fslot is not None:
-                    host[slot - lo_slot, lo:lo + self.words] = \
-                        frag.planes[fslot]
-        PAGING_STATS["block_builds"] += 1
-        return _engine_put(host)
+        # the stack.build span covers host assembly AND the upload (the
+        # device.h2d_copy span nests inside it): staging cost must be
+        # attributable in traces, and its absence is what certifies a
+        # warm resident query
+        with get_tracer().start_span(
+                "stack.build", block=bi,
+                rows=hi_slot - lo_slot, words=self.total_words):
+            host = np.zeros((self.block_rows, self.total_words),
+                            dtype=np.uint32)
+            for si, frag in enumerate(self._fragments):
+                if frag is None:
+                    continue
+                lo = si * self.words
+                for slot in range(lo_slot, hi_slot):
+                    fslot = frag.row_index.get(self.row_ids[slot])
+                    if fslot is not None:
+                        host[slot - lo_slot, lo:lo + self.words] = \
+                            frag.planes[fslot]
+            PAGING_STATS["block_builds"] += 1
+            return _engine_put(host)
 
     def _ensure_block(self, bi: int) -> jax.Array:
         blk = self._blocks[bi]
@@ -288,7 +328,7 @@ class StackedSet:
                         "fragment advanced past the stack snapshot")
             blk = self._build_block_host(bi)
             self._blocks[bi] = blk
-        if self.paged and not self.ephemeral:
+        if not self.ephemeral:
             BUDGET.charge((self.serial, bi), blk.nbytes,
                           lambda s=self, i=bi: s._drop_block(i))
         return blk
@@ -301,7 +341,8 @@ class StackedSet:
             BUDGET.release((self.serial, bi))
 
     def _drop_block(self, bi: int) -> None:
-        # unpaged stacks are never registered with the budget
+        # eviction callback (paged blocks AND the unpaged block 0): the
+        # next touch lazily rebuilds under the version check
         self._blocks[bi] = None
 
     def iter_blocks(self) -> Iterator[Tuple[int, jax.Array]]:
@@ -323,9 +364,7 @@ class StackedSet:
     # -- reads ----------------------------------------------------------------
 
     def zero_plane(self) -> jax.Array:
-        if self._zero is None:
-            self._zero = jnp.zeros((self.total_words,), dtype=jnp.uint32)
-        return self._zero
+        return bitops.device_zeros(self.total_words)
 
     def row_plane(self, row: int) -> jax.Array:
         """Device [S*W] plane for one row id (zeros when absent). Point
@@ -397,21 +436,78 @@ class StackedBSI:
     Bit depth is bounded (<= 2 + 64 planes), so BSI stacks never page;
     shards with shallower depth than the widest member are zero-padded
     (a zero magnitude plane contributes nothing to compares or sums).
+    Like StackedSet blocks, the plane tensor is budget-charged and
+    evictable: an evicted tensor lazily rebuilds on next touch with the
+    same version check (a write since the snapshot -> StackStale).
     """
 
-    def __init__(self, shards: Sequence[int], fragments, words: int = WORDS_PER_SHARD):
+    def __init__(self, shards: Sequence[int], fragments,
+                 words: int = WORDS_PER_SHARD, write_lock=None):
         self.shards = tuple(shards)
         self.words = words
         self.total_words = len(self.shards) * words
         depth = max([f.depth for f in fragments if f is not None] or [1])
         self.depth = depth
-        host = np.zeros((bsiops.OFFSET + depth, self.total_words), dtype=np.uint32)
-        for si, frag in enumerate(fragments):
-            if frag is None:
-                continue
-            lo = si * words
-            host[: frag.planes.shape[0], lo:lo + words] = frag.planes
-        self.planes: jax.Array = _engine_put(host)
+        self.serial = next(_stack_serial)
+        self._write_lock = (write_lock if write_lock is not None
+                            else contextlib.nullcontext())
+        self._lock = threading.Lock()
+        self.ephemeral = False
+        self._fragments = list(fragments)
+        self._built_vers = tuple(
+            -1 if f is None else f.version for f in fragments)
+        self._planes: Optional[jax.Array] = self._build_host()
+        self._charge()
+
+    def _build_host(self) -> jax.Array:
+        from pilosa_tpu.obs.tracing import get_tracer
+
+        with get_tracer().start_span(
+                "stack.build", kind="bsi", planes=bsiops.OFFSET + self.depth,
+                words=self.total_words):
+            host = np.zeros((bsiops.OFFSET + self.depth, self.total_words),
+                            dtype=np.uint32)
+            for si, frag in enumerate(self._fragments):
+                if frag is None:
+                    continue
+                lo = si * self.words
+                host[: frag.planes.shape[0], lo:lo + self.words] = frag.planes
+            return _engine_put(host)
+
+    def _charge(self) -> None:
+        blk = self._planes
+        if blk is not None and not self.ephemeral:
+            BUDGET.charge((self.serial, 0), blk.nbytes,
+                          lambda s=self: s._drop())
+
+    def _drop(self) -> None:
+        self._planes = None
+
+    def release_device(self) -> None:
+        BUDGET.release((self.serial, 0))
+
+    @property
+    def planes(self) -> jax.Array:
+        blk = self._planes
+        if blk is not None:
+            BUDGET.touch((self.serial, 0))
+            return blk
+        # evicted: rebuild under the writer lock with the version check
+        # (same protocol as StackedSet._ensure_block — a torn or stale
+        # rebuild must never serve a read)
+        with self._write_lock, self._lock:
+            blk = self._planes
+            if blk is not None:
+                return blk
+            for frag, built_v in zip(self._fragments, self._built_vers):
+                if (frag.version if frag is not None else -1) != built_v:
+                    PAGING_STATS["stale_retries"] += 1
+                    raise StackStale(
+                        "fragment advanced past the stack snapshot")
+            blk = self._build_host()
+            self._planes = blk
+        self._charge()
+        return blk
 
     def exists_plane(self) -> jax.Array:
         return self.planes[bsiops.EXISTS]
@@ -455,6 +551,9 @@ def _cache_get(field, group, subset, vers):
         hit = inner.get(subset)
         if hit is not None and hit[0] == vers:
             inner.move_to_end(subset)
+            from pilosa_tpu.obs import metrics as M
+
+            M.REGISTRY.count(M.METRIC_DEVICE_RESIDENT_HITS)
             return hit[1]
         return None
 
@@ -650,7 +749,13 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
                 w, b = divmod(col, BITS_PER_WORD)
                 acc.clear(slot, lo + w, b)
     if not acc.masks and not new_rows:
-        return stack  # versions moved with no net representable delta
+        # versions moved with no net representable delta: re-stamp the
+        # snapshot (caller holds the writer lock) so a later lazy
+        # rebuild of an evicted block doesn't raise a spurious stale
+        stack._fragments = list(fragments)
+        stack._built_vers = tuple(
+            -1 if f is None else f.version for f in fragments)
+        return stack
     new = StackedSet.__new__(StackedSet)
     new.shards = stack.shards
     new.words = stack.words
@@ -659,7 +764,6 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
     new.block_rows = stack.block_rows
     new._lock = threading.Lock()
     new._write_lock = stack._write_lock
-    new._zero = None
     new.ephemeral = False
     new._fragments = list(fragments)
     new._built_vers = tuple(
@@ -683,9 +787,17 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
         new.cap = new.block_rows
         new.paged = False
         blk = stack._blocks[0]
+        if blk is None:
+            return None  # resident block was evicted: rebuild from host
         if new.cap > stack.cap:
             blk = _grow_rows_device(blk, new.cap - stack.cap)
-        new._blocks = [acc.apply(blk, 0, new.cap)]
+        blk = acc.apply(blk, 0, new.cap)
+        # assign before charging: an eviction cascade can immediately
+        # call the new entry's neighbors' callbacks, and new's own
+        # callback reads _blocks
+        new._blocks = [blk]
+        BUDGET.charge((new.serial, 0), blk.nbytes,
+                      lambda s=new: s._drop_block(0))
         return new
     # paged: block_rows is fixed; appends extend the lazy block list.
     # Scatter the masks into each *materialized* block; unmaterialized
@@ -717,7 +829,14 @@ def _advance_bsi(stack: "StackedBSI", fragments, built_vers) -> Optional["Stacke
     from pilosa_tpu.ops.bsi import EXISTS, OFFSET, SIGN
     from pilosa_tpu.shardwidth import BITS_PER_WORD
 
-    n_planes = stack.planes.shape[0]
+    # read the raw tensor: the planes property would try to REBUILD an
+    # evicted tensor at the old snapshot and correctly raise StackStale
+    # (fragments have advanced — that's why we're here); an evicted base
+    # simply means a full rebuild from the current host state
+    base = stack._planes
+    if base is None:
+        return None
+    n_planes = base.shape[0]
     acc = _MaskAccum()
     for si, (frag, built_v) in enumerate(zip(fragments, built_vers)):
         if frag is None:
@@ -757,13 +876,24 @@ def _advance_bsi(stack: "StackedBSI", fragments, built_vers) -> Optional["Stacke
                 for p in range(n_planes):
                     acc.clear(p, lo + w, b)
     if not acc.masks:
+        stack._fragments = list(fragments)
+        stack._built_vers = tuple(
+            -1 if f is None else f.version for f in fragments)
         return stack
     new = StackedBSI.__new__(StackedBSI)
     new.shards = stack.shards
     new.words = stack.words
     new.total_words = stack.total_words
     new.depth = stack.depth
-    new.planes = acc.apply(stack.planes)
+    new.serial = next(_stack_serial)
+    new._write_lock = stack._write_lock
+    new._lock = threading.Lock()
+    new.ephemeral = False
+    new._fragments = list(fragments)
+    new._built_vers = tuple(
+        -1 if f is None else f.version for f in fragments)
+    new._planes = acc.apply(base)
+    new._charge()
     return new
 
 
@@ -819,7 +949,8 @@ def stacked_bsi(field, shards: Sequence[int]) -> StackedBSI:
             hit = _advance_or_rebuild(
                 field, group, subset, vers, fragments,
                 advance=_advance_bsi,
-                rebuild=lambda: StackedBSI(shards, fragments))
+                rebuild=lambda: StackedBSI(
+                    shards, fragments, write_lock=_writer_lock(field)))
     return hit
 
 

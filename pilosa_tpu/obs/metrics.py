@@ -145,6 +145,14 @@ METRIC_TRACE_STAGE_LATENCY = "trace_stage_latency_ms"  # histogram
 # a stage's share of the root is readable bucket-for-bucket
 TRACE_DURATION_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
                              250.0, 500.0, 1000.0, 5000.0)
+# device-residency plane (core/stacked.py): bytes of stacked fragment
+# planes pinned in HBM under the DeviceBudget, resident stacks evicted
+# to make room (each eviction means a future query pays stack.build +
+# device.h2d_copy again), and queries served entirely from resident
+# device planes (the warm path the dispatch-floor work exists for)
+METRIC_DEVICE_HBM_RESIDENT_BYTES = "device_hbm_resident_bytes"
+METRIC_DEVICE_STACK_EVICTIONS = "device_stack_evictions_total"
+METRIC_DEVICE_RESIDENT_HITS = "device_resident_hits_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

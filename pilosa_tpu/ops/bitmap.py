@@ -17,6 +17,8 @@ reference's analog is its per-call Go hot loops; ours is compile-once).
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -32,6 +34,31 @@ from pilosa_tpu.shardwidth import BITS_PER_WORD, SHARD_WIDTH, WORDS_PER_SHARD
 
 def zero_plane(words: int = WORDS_PER_SHARD) -> np.ndarray:
     return np.zeros(words, dtype=np.uint32)
+
+
+# One shared all-zeros device plane per word count, LRU-bounded. Absent
+# rows, empty unions, and the resident-program scratch all read the SAME
+# buffer instead of each caller growing its own per-shape dict (the
+# Executor._zeros unbounded-growth fix). Callers must never mutate or
+# donate it on a backend that honors donation — platform.donate_argnums
+# gates that off on CPU, the only place the shared plane is passed as
+# scratch.
+_DEVICE_ZEROS_CAP = 8
+_DEVICE_ZEROS: "dict" = {}
+_DEVICE_ZEROS_LOCK = threading.Lock()
+
+
+def device_zeros(words: int):
+    """Shared device ``uint32[words]`` zeros plane (bounded cache)."""
+    with _DEVICE_ZEROS_LOCK:
+        z = _DEVICE_ZEROS.get(words)
+    if z is None:
+        z = jnp.zeros((words,), dtype=jnp.uint32)
+        with _DEVICE_ZEROS_LOCK:
+            _DEVICE_ZEROS[words] = z
+            while len(_DEVICE_ZEROS) > _DEVICE_ZEROS_CAP:
+                _DEVICE_ZEROS.pop(next(iter(_DEVICE_ZEROS)))
+    return z
 
 
 def bits_to_plane(cols, words: int = WORDS_PER_SHARD) -> np.ndarray:

@@ -121,11 +121,10 @@ def engine_sharding(ndim: int,
 
 
 def engine_put(host: np.ndarray) -> jax.Array:
-    """device_put a stacked tensor with the engine placement."""
+    """device_put a stacked tensor with the engine placement (traced as
+    a ``device.h2d_copy`` stage — staging cost must be attributable)."""
     sh = engine_sharding(host.ndim, host.shape[-1])
-    with platform.dispatch_guard():  # leaf: multi-device transfer program
-        return (jax.device_put(host, sh) if sh is not None
-                else jax.device_put(host))
+    return platform.h2d_copy(host, sh)
 
 
 def analytics_mesh(devices: Optional[Sequence] = None,
@@ -157,9 +156,8 @@ class ShardPlacement:
 
     def place(self, arr) -> jax.Array:
         arr = np.asarray(arr)
-        with platform.dispatch_guard():  # leaf: multi-device transfer
-            return jax.device_put(
-                arr, NamedSharding(self.mesh, self.spec(arr.ndim)))
+        return platform.h2d_copy(
+            arr, NamedSharding(self.mesh, self.spec(arr.ndim)))
 
     # -- collective kernels ------------------------------------------------
 
@@ -236,6 +234,97 @@ def _groupby_counts(mesh, a, b):
         local, _ = lax.scan(one, init, (la, lb))
         return lax.psum(local, (SHARD_AXIS, COL_AXIS))
     return f(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Per-query-family compiled programs (pql/programs.py). A query family is
+# lowered to an op tape — a register machine whose registers start as the
+# leaf planes (resident row planes / existence / zeros) and whose ops are
+# the four bitmap combinators — and the whole tape plus its terminal
+# (popcount-reduce or plane materialization) compiles to ONE executable.
+# The warm path then launches exactly one program per query instead of a
+# Python loop of per-op dispatches: that loop, not data volume, is the
+# ~67ms floor BENCH_r05 measured.
+# ---------------------------------------------------------------------------
+
+def _tape_eval(tape, leaves):
+    """Run an op tape over leaf planes. regs[0..n-1] are the leaves; each
+    ("and"|"or"|"xor"|"andnot", i, j) op appends a register; the last
+    register is the result. Pure jnp — traceable inside jit/shard_map."""
+    regs = list(leaves)
+    for op, i, j in tape:
+        a, b = regs[i], regs[j]
+        if op == "and":
+            regs.append(a & b)
+        elif op == "or":
+            regs.append(a | b)
+        elif op == "xor":
+            regs.append(a ^ b)
+        elif op == "andnot":
+            regs.append(a & ~b)
+        else:  # defensive: an unknown op is a compiler bug, not data
+            raise ValueError(f"unknown tape op {op!r}")
+    return regs[-1]
+
+
+def _tape_result(tape, masked, args):
+    if masked:
+        mask, leaves = args[-1], args[:-1]
+    else:
+        mask, leaves = None, args
+    out = _tape_eval(tape, leaves)
+    if masked:
+        out = out & mask
+    return out
+
+
+def compile_tape_count(tape, masked: bool, total_words: int):
+    """Compile ``popcount(tape-result [& mask])`` into one executable.
+
+    When the fused word axis divides over the engine mesh the reduce is
+    an explicit shard_map + ``lax.psum`` over (shards, cols) — the count
+    arrives on-device, no host-side merge. Otherwise a plain jit (GSPMD
+    still inserts collectives from the leaf shardings when they happen
+    to be placed). Callers cache the returned fn per (tape, shape
+    bucket, mesh epoch)."""
+    mesh = engine_mesh()
+    use_mesh = (mesh.devices.size > 1
+                and total_words % mesh.devices.size == 0)
+
+    if use_mesh:
+        spec = P((SHARD_AXIS, COL_AXIS))
+
+        @jax.jit
+        def fn(*args):
+            @functools.partial(_shard_map, mesh=mesh,
+                               in_specs=(spec,) * len(args), out_specs=P())
+            def f(*largs):
+                c = jnp.sum(_popcount_i32(_tape_result(tape, masked, largs)))
+                return lax.psum(c, (SHARD_AXIS, COL_AXIS))
+            return f(*args)
+    else:
+        @jax.jit
+        def fn(*args):
+            return jnp.sum(_popcount_i32(_tape_result(tape, masked, args)))
+
+    return platform.guarded_call(fn)
+
+
+def compile_tape_plane(tape, masked: bool):
+    """Compile ``(tape-result [& mask]) | scratch`` into one executable.
+
+    ``scratch`` is an all-zeros plane whose only job is to be the
+    donated output buffer: on device backends steady-state queries then
+    allocate nothing. On CPU XLA ignores donation (platform.
+    donate_argnums gates it off), which is what lets the caller pass the
+    long-lived shared zeros plane without it being consumed."""
+
+    @functools.partial(jax.jit,
+                       donate_argnums=platform.donate_argnums(0))
+    def fn(scratch, *args):
+        return _tape_result(tape, masked, args) | scratch
+
+    return platform.guarded_call(fn)
 
 
 @platform.guarded_call

@@ -86,6 +86,13 @@ def force_cpu_platform(n_devices: int | None = None):
 # guard -> stale-block rebuild takes write_lock, while writers take
 # write_lock -> launch takes guard: AB-BA), and holding it across
 # loopback-HTTP fan-out starves the serving threads.
+#
+# Persistent executables (the per-query-family compiled programs in
+# pql/programs.py, cached across queries) compose with the guard the
+# same way ad-hoc jits do: the cache lookup is lock-free, and only the
+# *invocation* of the cached executable runs under guarded_call — so the
+# warm path pays one leaf-lock acquisition per launch, never a
+# recompile, and CPU still sees at most one sharded program in flight.
 # ---------------------------------------------------------------------------
 
 _DISPATCH_LOCK = threading.RLock()
@@ -106,6 +113,47 @@ def dispatch_guard():
         except Exception:  # backend init failed: stay safe, serialize
             _GUARD_IS_LOCK = True
     return _DISPATCH_LOCK if _GUARD_IS_LOCK else _NULL_GUARD
+
+
+def backend_supports_donation() -> bool:
+    """Whether ``donate_argnums`` actually reuses buffers here.
+
+    XLA:CPU ignores donation (and warns per-compile), so donated scratch
+    is only wired on device backends; callers that share a long-lived
+    zeros plane as scratch rely on this — a *real* donation would
+    consume the shared buffer.
+    """
+    dispatch_guard()  # resolves _GUARD_IS_LOCK (cpu <=> lock)
+    return not _GUARD_IS_LOCK
+
+
+def donate_argnums(*nums: int):
+    """``donate_argnums`` tuple for ``jax.jit``, empty on CPU where XLA
+    cannot honor donation (avoids both the per-compile warning and
+    consuming buffers the caller still holds)."""
+    return nums if backend_supports_donation() else ()
+
+
+def h2d_copy(host, sharding=None):
+    """Host→device transfer under the dispatch guard, traced as a
+    ``device.h2d_copy`` span tagged with the byte count.
+
+    Every staging path (mesh.engine_put, fragment.device_planes) routes
+    through here so transfer-vs-dispatch attribution shows up in
+    `profile=true` traces: a warm resident query must have NO
+    device.h2d_copy stage at all.
+    """
+    import jax
+    import numpy as np
+
+    from pilosa_tpu.obs.tracing import get_tracer
+
+    arr = np.asarray(host)
+    with dispatch_guard():
+        with get_tracer().start_span("device.h2d_copy", nbytes=arr.nbytes):
+            if sharding is not None:
+                return jax.device_put(arr, sharding)
+            return jax.device_put(arr)
 
 
 def guarded_call(fn):

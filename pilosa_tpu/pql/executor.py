@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import copy
 import datetime as dt
+import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -41,6 +43,7 @@ from pilosa_tpu.ops import bsi as S
 from pilosa_tpu.ops.groupby import pair_counts, pair_sums
 from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS, unwrap_options
 from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.pql import programs
 from pilosa_tpu.pql import result as R
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 
@@ -88,6 +91,37 @@ def query_maskable(query) -> bool:
     return True
 
 
+# Device-resident ShardMask planes, LRU-bounded and keyed by
+# (mesh epoch, union layout, subset): masks depend only on shard lists,
+# never data, so warm fused dispatches (sched/batch.py) find their mask
+# already on device instead of re-building + re-staging a host plane per
+# ShardMask construction.
+_MASK_CAP = 32
+_MASK_PLANES: "OrderedDict[Tuple, jnp.ndarray]" = OrderedDict()
+_MASK_LOCK = threading.Lock()
+
+
+def _mask_plane(shard_list: Tuple[int, ...], subset) -> jnp.ndarray:
+    from pilosa_tpu.obs import metrics as M
+    from pilosa_tpu.parallel import mesh
+
+    key = (mesh.mesh_epoch(), shard_list, subset)
+    with _MASK_LOCK:
+        hit = _MASK_PLANES.get(key)
+        if hit is not None:
+            _MASK_PLANES.move_to_end(key)
+    if hit is not None:
+        M.REGISTRY.count(M.METRIC_DEVICE_RESIDENT_HITS)
+        return hit
+    plane = mesh.engine_put(B.shard_mask_plane(shard_list, subset))
+    with _MASK_LOCK:
+        plane = _MASK_PLANES.setdefault(key, plane)
+        _MASK_PLANES.move_to_end(key)
+        while len(_MASK_PLANES) > _MASK_CAP:
+            _MASK_PLANES.popitem(last=False)
+    return plane
+
+
 class ShardMask:
     """Per-query shard-subset mask over a union stacked layout (superset
     fusion, sched/batch.py): a ``uint32[S*W]`` word plane with all-ones
@@ -104,8 +138,7 @@ class ShardMask:
     def __init__(self, shard_list: Sequence[int], subset):
         self.shard_list = [int(s) for s in shard_list]
         self.subset = frozenset(int(s) for s in subset)
-        self.plane = jnp.asarray(
-            B.shard_mask_plane(self.shard_list, self.subset))
+        self.plane = _mask_plane(tuple(self.shard_list), self.subset)
 
 
 def has_write_calls(query) -> bool:
@@ -182,7 +215,6 @@ class Executor:
         # result cache (cache/), attached by api.enable_cache(). None
         # keeps the read path byte-identical to the uncached build.
         self.cache = None
-        self._zeros: Dict[int, jnp.ndarray] = {}
 
     # -- public entry (reference: executor.go:183 Execute) --------------------
 
@@ -467,10 +499,9 @@ class Executor:
         return sorted(idx.shards())
 
     def _zero(self, words: int) -> jnp.ndarray:
-        z = self._zeros.get(words)
-        if z is None:
-            z = self._zeros[words] = jnp.zeros((words,), dtype=jnp.uint32)
-        return z
+        # shared bounded cache (ops/bitmap.py) — also the CPU scratch of
+        # the resident plane programs, so one buffer serves both
+        return B.device_zeros(words)
 
     def _existence_all(self, idx: Index, shard_list: List[int]) -> jnp.ndarray:
         ex = idx.existence
@@ -685,10 +716,14 @@ class Executor:
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return self._row_result(idx, [])
-        plane = self._eval_all(idx, call, shard_list, mask)
-        if mask is not None:
-            # restrict materialized columns to the query's own shards
-            plane = B.plane_and(plane, mask.plane)
+        # warm path: one compiled program over resident planes (mask
+        # applied in-program); None -> classic per-op evaluation
+        plane = programs.run_plane(self, idx, call, shard_list, mask)
+        if plane is None:
+            plane = self._eval_all(idx, call, shard_list, mask)
+            if mask is not None:
+                # restrict materialized columns to the query's own shards
+                plane = B.plane_and(plane, mask.plane)
 
         def finalize(plane_np: np.ndarray):
             shaped = plane_np.reshape(len(shard_list), WORDS_PER_SHARD)
@@ -725,12 +760,16 @@ class Executor:
         shard_list = self._shards(idx, shards)
         if not shard_list:
             return 0
-        plane = self._eval_all(idx, child, shard_list, mask)
-        if mask is None:
-            count = B.plane_count(plane)
-        else:
-            # fused AND+popcount — the mask never materializes on host
-            count = B.plane_intersection_count(plane, mask.plane)
+        # warm path: ops + popcount + cross-shard psum in ONE compiled
+        # program over resident planes; None -> classic per-op path
+        count = programs.run_count(self, idx, child, shard_list, mask)
+        if count is None:
+            plane = self._eval_all(idx, child, shard_list, mask)
+            if mask is None:
+                count = B.plane_count(plane)
+            else:
+                # fused AND+popcount — the mask never materializes on host
+                count = B.plane_intersection_count(plane, mask.plane)
         return _Deferred([count], lambda c: int(c))
 
     # -- BSI aggregates (reference: executor.go executeSum/Min/Max) -----------

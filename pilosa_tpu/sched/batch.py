@@ -23,6 +23,7 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 from pilosa_tpu.cache.keys import shard_key
+from pilosa_tpu.obs import metrics as M
 from pilosa_tpu.obs.tracing import NOP_SPAN, get_tracer, span_scope
 from pilosa_tpu.pql.ast import Call, Query, unwrap_options
 
@@ -117,12 +118,16 @@ def execute_batch(executor, entries: List) -> None:
             _run_single(executor, e)
         return
     t0 = time.perf_counter()
+    # resident-stack hits across the whole fused dispatch: a fully warm
+    # batch shows resident_hits > 0 and no stack.build/h2d stages — the
+    # observable proof that superset fusion rode the resident programs
+    hits0 = M.REGISTRY.value(M.METRIC_DEVICE_RESIDENT_HITS)
     try:
         # the fused dispatch runs under the head entry's span scope —
         # device spans land on the query that "paid" for the dispatch;
         # every batch-mate gets a post-hoc sched.fuse record below
         with span_scope(_entry_span(first)), \
-                get_tracer().start_span("sched.fuse", fused=len(entries)):
+                get_tracer().start_span("sched.fuse", fused=len(entries)) as sp:
             if hetero:
                 # cross-shard-set fusion: one dispatch over the union
                 # layout, each query masked to its own subset
@@ -144,6 +149,9 @@ def execute_batch(executor, entries: List) -> None:
                 results = executor.execute(first.index, Query(calls),
                                            shards=first.shards)
                 per_query = [results[off:off + n] for off, n in spans]
+            resident_hits = (
+                M.REGISTRY.value(M.METRIC_DEVICE_RESIDENT_HITS) - hits0)
+            sp.set_tag("resident_hits", resident_hits)
     except Exception:
         for e in entries:
             _run_single(executor, e)
@@ -151,7 +159,8 @@ def execute_batch(executor, entries: List) -> None:
     fuse_s = time.perf_counter() - t0
     for e, res in zip(entries, per_query):
         if e is not first:
-            _entry_span(e).record("sched.fuse", fuse_s, fused=len(entries))
+            _entry_span(e).record("sched.fuse", fuse_s, fused=len(entries),
+                                  resident_hits=resident_hits)
         e.future.set_result(res)
 
 

@@ -61,6 +61,22 @@ PILOSA_TPU_TRACE=1 PILOSA_TPU_TRACE_SAMPLE_RATE=1.0 JAX_PLATFORMS=cpu \
     tests/test_cache.py tests/test_tracing.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly || exit $?
 
+echo "== device-budget lane (PILOSA_TPU_DEVICE_BUDGET clamped) =="
+# The residency plane must stay correct when HBM is scarce: an 8MB cap
+# with 4MB blocks forces paging AND eviction of resident planes on the
+# same suites that assert bit-exact results and budget accounting.
+PILOSA_TPU_DEVICE_BUDGET=$((8 << 20)) PILOSA_TPU_BLOCK_BYTES_MB=4 \
+    JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_resident.py tests/test_paging.py \
+    tests/test_stacked_merge.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== resident warm-vs-cold bench gate (bench.py --configs 13) =="
+# Hard-asserts the ISSUE 8 acceptance bar in-process: warm resident p50
+# >= 5x below cold, results bit-identical to the non-resident oracle,
+# and no device.h2d_copy stage in any warm query's trace.
+JAX_PLATFORMS=cpu python bench.py --configs 13 || exit $?
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
