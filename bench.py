@@ -1040,6 +1040,143 @@ def bench_config13(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 14 — coalesced fan-out: batched vs unbatched node RPCs at 64-way
+# ---------------------------------------------------------------------------
+
+def bench_config14(device: str) -> None:
+    """3-node cluster (replica_n=2), 64 concurrent mixed-shard Count
+    queries released through a barrier. Unbatched, every query's fan-out
+    ships one /internal/query RPC per remote primary node; with the
+    per-node coalescer (ISSUE 9) concurrent legs to the same node ride
+    ONE /internal/query-batch RPC through the remote execute_many
+    superset-merge. HARD asserts: every result in every phase equals a
+    numpy bincount oracle, and the batched pass ships >=8x fewer
+    per-node RPCs than the unbatched pass for the same workload. A
+    final chaos wave (FaultPlan delay scoped op="query_batch" on one
+    node + hedging) re-asserts bit-identity when batches straggle and
+    hedged batch legs race replicas."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.cluster import FaultPlan, LocalCluster
+    from pilosa_tpu.obs.metrics import MetricsRegistry
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(14)
+    plan = FaultPlan(seed=14)  # unarmed until the chaos wave
+    c = LocalCluster(3, replica_n=2, fault_plan=plan)
+    try:
+        co = c.coordinator
+        co.create_index("c14")
+        co.create_field("c14", "f")
+        n_shards, n_rows, per_shard = 6, 8, _n(40_000)
+        row_counts = []
+        for shard in range(n_shards):
+            rows = rng.integers(0, n_rows, per_shard)
+            cols = shard * SHARD_WIDTH + np.arange(per_shard)
+            co.import_bits("c14", "f", rows=rows.tolist(),
+                           cols=cols.tolist())
+            row_counts.append(np.bincount(rows, minlength=n_rows))
+
+        # 64 mixed-shard queries: row varies with i, each reads its own
+        # random shard subset — so concurrent legs hit the same nodes
+        # with DIFFERENT (pql, shards) pairs and only the coalescer (not
+        # dedup or caching) can collapse the wire traffic
+        nq = 64
+        queries = []
+        for i in range(nq):
+            row = i % n_rows
+            subset = sorted(int(s) for s in rng.choice(
+                n_shards, size=int(rng.integers(2, n_shards)),
+                replace=False))
+            want = int(sum(row_counts[s][row] for s in subset))
+            queries.append((f"Count(Row(f={row}))", subset, want))
+
+        def run_wave(batch) -> list:
+            """All queries released at once; per-query wall latency."""
+            barrier = threading.Barrier(len(batch))
+
+            def one(entry):
+                pql, subset, want = entry
+                barrier.wait()
+                t0 = time.perf_counter()
+                r = co.query("c14", pql, shards=subset)
+                dt = time.perf_counter() - t0
+                assert r == [want], f"{pql} over {subset}: {r} != [{want}]"
+                return dt
+
+            with ThreadPoolExecutor(max_workers=len(batch)) as pool:
+                return list(pool.map(one, batch))
+
+        waves = 3
+        co.query("c14", queries[0][0], shards=queries[0][1])  # warm placement
+
+        sent0 = dict(co.client.op_counts)
+        unbatched = []
+        for _ in range(waves):
+            unbatched.extend(run_wave(queries))
+        solo_rpcs = co.client.op_counts.get("query", 0) - \
+            sent0.get("query", 0)
+
+        co.enable_cluster_batch()
+        sent0 = dict(co.client.op_counts)
+        batched = []
+        for _ in range(waves):
+            batched.extend(run_wave(queries))
+        batch_rpcs = co.client.op_counts.get("query_batch", 0) - \
+            sent0.get("query_batch", 0)
+        assert co.client.op_counts.get("query", 0) == \
+            sent0.get("query", 0), \
+            "batched pass leaked legs onto the solo /internal/query RPC"
+
+        reduction = solo_rpcs / max(batch_rpcs, 1)
+        assert batch_rpcs > 0 and reduction >= 8.0, \
+            f"coalescer only cut per-node RPCs {reduction:.1f}x " \
+            f"({solo_rpcs} solo vs {batch_rpcs} batched; <8x)"
+
+        # chaos wave: delay every batch RPC to one remote primary; the
+        # hedged batch leg races the replicas and every demuxed member
+        # must still match the oracle bit-for-bit
+        reg = MetricsRegistry()
+        # hedge well before the 0.3s injected delay but not instantly
+        # (16 concurrent queries would hedge EVERYTHING at 1ms), and
+        # floor the adaptive leg timeout high enough that a healthy but
+        # GIL-contended replica leg is never reaped — a reaped primary
+        # plus a reaped hedge exhausts both owners and fails the query
+        co.enable_resilience(registry=reg, hedge_min_ms=30.0,
+                             timeout_min_ms=5000.0,
+                             breaker_threshold=1 << 30)
+        for _ in range(2):  # warm the per-node latency tracker
+            run_wave(queries[:16])
+        victim = next(n.node.id for n in c.nodes[1:]
+                      if n.holder.index("c14").shards())
+        plan.delay(victim, 0.3, op="query_batch")
+        run_wave(queries[:16])  # asserts oracle equality inside
+        plan.clear()
+        co.disable_resilience()
+        co.disable_cluster_batch()
+        counters = reg.as_json()["counters"]
+        hedges = sum(v for k, v in counters.items()
+                     if k.startswith("cluster_hedges_total"))
+    finally:
+        c.close()
+
+    def pct(lat, p):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+    batched_p99 = pct(batched, 0.99)
+    _emit(f"c14_batched_fanout_p99_64way{SCALED} ({device})", batched_p99,
+          "ms", pct(unbatched, 0.99) / max(batched_p99, 1e-6),
+          p99_unbatched_ms=pct(unbatched, 0.99),
+          p50_batched_ms=pct(batched, 0.5),
+          p50_unbatched_ms=pct(unbatched, 0.5),
+          rpcs_unbatched=solo_rpcs, rpcs_batched=batch_rpcs,
+          rpc_reduction=reduction, chaos_hedges=hedges,
+          queries=nq, waves=waves, floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -1193,6 +1330,7 @@ _CONFIGS = {
     "11": bench_config11,
     "12": bench_config12,
     "13": bench_config13,
+    "14": bench_config14,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

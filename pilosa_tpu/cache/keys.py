@@ -12,6 +12,14 @@ reads are structurally impossible.
 
 ``shard_key`` is shared with the scheduler's grouping key
 (sched/batch.py) so the two canonicalizations can never drift.
+
+Remote-leg entries (ClusterExecutor._map_shards "rleg"/"rlegg" keys)
+sit ABOVE the cluster leg coalescer (cluster/batch.py): each leg's
+cache wrapper keys on that query's own PQL + shard set and only calls
+into the batcher on a miss. A multi-query batch RPC therefore fills one
+exact per-leg entry per member — partials from a shared wire call are
+never cross-keyed, and a later solo query hits the entry its shards
+earned regardless of which batch happened to carry the fill.
 """
 
 from __future__ import annotations

@@ -10,6 +10,7 @@ host, shards spread over the TPU device mesh instead
 from pilosa_tpu.cluster.broadcast import (  # noqa: F401
     Broadcaster, HTTPBroadcaster, NopBroadcaster,
 )
+from pilosa_tpu.cluster.batch import NodeBatcher  # noqa: F401
 from pilosa_tpu.cluster.client import (  # noqa: F401
     InternalClient, LegCancelled, NodeDownError, RemoteError,
 )

@@ -7,6 +7,13 @@ retryablehttp (internal_client.go:1744). ConnectionError is surfaced as
 NodeDownError so the executor can fail over to replicas
 (executor.go:6500-6515).
 
+Transport: per-node keep-alive connection pools (the server speaks
+HTTP/1.1 with Content-Length on every response), so repeated legs to
+the same peer reuse a socket instead of paying TCP setup per request —
+the reference gets this for free from net/http's Transport. A pooled
+connection the peer quietly closed gets ONE fresh-socket retry that
+does not consume a retry attempt or re-consult the fault plan.
+
 Within one host the TPU engine never uses this path — shards on the
 local mesh reduce via XLA collectives; this client only carries
 host-to-host traffic (and the control plane).
@@ -14,13 +21,16 @@ host-to-host traffic (and the control plane).
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
 import socket
+import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
+from urllib.parse import urlsplit
 
 from pilosa_tpu.obs.tracing import active_span, current_traceparent
 
@@ -44,10 +54,63 @@ class LegCancelled(RuntimeError):
     as a node failure."""
 
 
+class _ConnPool:
+    """Bounded per-node pool of keep-alive HTTP connections.
+
+    Keyed on the target node id when the caller knows it (so the
+    breaker can evict a node's sockets by id) and on netloc otherwise.
+    ``per_key`` bounds idle sockets per node; overflow returns close
+    rather than queue — a fan-out burst briefly opens extras and the
+    steady state keeps the newest ``per_key``."""
+
+    def __init__(self, per_key: int = 4):
+        self.per_key = max(1, int(per_key))
+        self._lock = threading.Lock()
+        self._idle: Dict[str, List[http.client.HTTPConnection]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: str) -> Optional[http.client.HTTPConnection]:
+        with self._lock:
+            conns = self._idle.get(key)
+            if conns:
+                self.hits += 1
+                return conns.pop()
+            self.misses += 1
+            return None
+
+    def put(self, key: str, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            conns = self._idle.setdefault(key, [])
+            if len(conns) < self.per_key:
+                conns.append(conn)
+                return
+            self.evictions += 1
+        conn.close()
+
+    def evict(self, key: str) -> int:
+        """Close every idle socket for a node (breaker opened: whatever
+        made the node fail may have wedged its half of the connections)."""
+        with self._lock:
+            conns = self._idle.pop(key, [])
+            self.evictions += len(conns)
+        for c in conns:
+            c.close()
+        return len(conns)
+
+    def close(self) -> None:
+        with self._lock:
+            all_conns = [c for conns in self._idle.values() for c in conns]
+            self._idle.clear()
+        for c in all_conns:
+            c.close()
+
+
 class InternalClient:
     def __init__(self, timeout: float = 30.0, retries: int = 2,
                  backoff: float = 0.05, sleep=None, rng=None,
-                 fault_plan=None):
+                 fault_plan=None, pool_size: int = 4):
         self.timeout = timeout
         self.retries = retries
         self.backoff = backoff
@@ -64,6 +127,20 @@ class InternalClient:
         # envelopes are applied — dissemination at RPC speed with zero
         # extra round-trips. ClusterNode.enable_gossip wires this.
         self.gossip = None
+        self.pool = _ConnPool(per_key=pool_size)
+        # wire-RPC accounting by op tag (one increment per actual send
+        # attempt, retries included) — bench.py compares batched vs
+        # unbatched fan-out RPC counts from these
+        self.op_counts: Dict[str, int] = {}
+        self._count_lock = threading.Lock()
+
+    def evict_node(self, node_id: str) -> int:
+        """Drop pooled sockets for a peer; ClusterNode wires this to the
+        breaker's open transition."""
+        return self.pool.evict(node_id)
+
+    def close(self) -> None:
+        self.pool.close()
 
     # -- transport ---------------------------------------------------------
 
@@ -79,34 +156,35 @@ class InternalClient:
             timeout = self.timeout
             if token is not None and token.timeout_s is not None:
                 timeout = max(1e-3, min(timeout, token.timeout_s))
-            req = urllib.request.Request(url, data=body, method=method)
+            headers: Dict[str, str] = {}
             if body is not None:
-                req.add_header("Content-Type", ctype)
+                headers["Content-Type"] = ctype
             # W3C-style trace propagation: every RPC made under a sampled
             # span scope (query legs, hedges, retries, translate, SQL
             # subtrees, recovery fetches) carries the context so the
             # serving node's spans join the coordinator's trace.
             tp = current_traceparent()
             if tp is not None:
-                req.add_header("traceparent", tp)
+                headers["traceparent"] = tp
                 if attempt:
-                    req.add_header("x-trace-attempt", str(attempt))
+                    headers["x-trace-attempt"] = str(attempt)
             try:
                 if self.fault_plan is not None and node_id is not None:
                     self.fault_plan.on_request(node_id, token=token, op=op)
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    data = resp.read()
-                    out = json.loads(data) if data else {}
-                    self._apply_trace(out)
-                    return out
-            except urllib.error.HTTPError as e:
-                msg = e.read().decode(errors="replace")
-                try:
-                    msg = json.loads(msg).get("error", msg)
-                except Exception:
-                    pass
-                raise RemoteError(e.code, msg) from None
-            except (urllib.error.URLError, socket.timeout, OSError) as e:
+                status, data = self._send_once(method, url, body, headers,
+                                               timeout, node_id, op)
+                if status >= 400:
+                    msg = data.decode(errors="replace")
+                    try:
+                        msg = json.loads(msg).get("error", msg)
+                    except Exception:
+                        pass
+                    raise RemoteError(status, msg)
+                out = json.loads(data) if data else {}
+                self._apply_trace(out)
+                return out
+            except (urllib.error.URLError, http.client.HTTPException,
+                    socket.timeout, OSError) as e:
                 last = e
                 if attempt < self.retries:
                     # Jittered exponential backoff: full-jitter over
@@ -122,6 +200,55 @@ class InternalClient:
                     else:
                         self._sleep(delay)
         raise NodeDownError(str(last))
+
+    def _send_once(self, method: str, url: str, body: Optional[bytes],
+                   headers: Dict[str, str], timeout: float,
+                   node_id: Optional[str],
+                   op: Optional[str]) -> "tuple[int, bytes]":
+        """One wire send over a pooled (or fresh) keep-alive connection.
+        Returns (status, body-bytes); transport problems raise OSError /
+        HTTPException for the caller's retry loop."""
+        sp = urlsplit(url)
+        with self._count_lock:
+            key = op or "other"
+            self.op_counts[key] = self.op_counts.get(key, 0) + 1
+        if sp.scheme != "http":  # https/unix/etc: one-shot via urllib
+            req = urllib.request.Request(url, data=body, method=method,
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+        pool_key = node_id or sp.netloc
+        path = sp.path + (f"?{sp.query}" if sp.query else "")
+        conn = self.pool.get(pool_key)
+        pooled = conn is not None
+        if conn is None:
+            conn = http.client.HTTPConnection(sp.hostname, sp.port,
+                                              timeout=timeout)
+        # a pooled socket the server already closed fails at send or at
+        # the status line — retry ONCE on a fresh socket, free of charge
+        for fresh_retry in (False, True):
+            try:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self.pool.put(pool_key, conn)
+                return resp.status, data
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                if not pooled or fresh_retry:
+                    raise
+                conn = http.client.HTTPConnection(sp.hostname, sp.port,
+                                                  timeout=timeout)
+        raise NodeDownError("unreachable")  # pragma: no cover
 
     def _post(self, node, path: str, payload: dict, token=None,
               op: Optional[str] = None) -> dict:
@@ -179,6 +306,26 @@ class InternalClient:
                              "query": pql, "shards": list(shards),
                              "remote": True,
                          }), token=token, op="query")
+        self._apply_gossip(out)
+        return out["results"]
+
+    def query_node_batch(self, node, entries: Sequence[dict],
+                         token=None) -> List[dict]:
+        """Ship many coalesced read legs to one peer as a single RPC
+        (cluster/batch.py -> /internal/query-batch). Each entry carries
+        ``index``/``query``/``shards``; the reply holds one demuxable
+        slot per entry — ``{"results": [wire...]}`` on success or
+        ``{"error": msg, "status": code}`` so one bad query never fails
+        its batch-mates. Gossip envelope and trace tree ride the batch
+        ONCE, not once per query."""
+        out = self._post(node, "/internal/query-batch",
+                         self._piggyback(node, {
+                             "queries": [{"index": e["index"],
+                                          "query": e["query"],
+                                          "shards": list(e["shards"])}
+                                         for e in entries],
+                             "remote": True,
+                         }), token=token, op="query_batch")
         self._apply_gossip(out)
         return out["results"]
 

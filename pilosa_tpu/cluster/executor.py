@@ -77,6 +77,13 @@ class ClusterExecutor:
         # circuit breakers, adaptive per-leg timeouts. READ fan-outs only
         # — the write path mirrors to every replica and never hedges.
         self.resilience = None
+        # optional per-node remote-leg coalescer (cluster/batch.py), set
+        # by ClusterNode.enable_cluster_batch: concurrent read legs to
+        # the same peer ship as one multi-query RPC. Sits BELOW the
+        # remote-leg caches (each query's partials stay keyed on its own
+        # shard set) and ABOVE the wire client (hedging/failover see the
+        # same error surface as solo legs).
+        self.batcher = None
         self.translator = ClusterTranslator(node_id, holder, client,
                                             snapshot_fn, live_fn=live_fn)
 
@@ -230,6 +237,10 @@ class ClusterExecutor:
         pql = call.to_pql()
 
         def run_remote(node, s, token=None):
+            batcher = self.batcher
+            if batcher is not None:
+                return R.result_from_wire(
+                    batcher.run(node, idx.name, pql, s, token=token)[0])
             return R.result_from_wire(
                 self.client.query_node(node, idx.name, pql, s,
                                        token=token)[0])

@@ -22,7 +22,8 @@ from pilosa_tpu.server.http import serve
 class LocalCluster:
     def __init__(self, n: int, replica_n: int = 1,
                  base_path: Optional[str] = None, disco_factory=None,
-                 fault_plan=None, client_factory=None):
+                 fault_plan=None, client_factory=None,
+                 cluster_batch: Optional[dict] = None):
         """``disco_factory()`` builds one DisCo per node (e.g. LeaseDisCo
         instances over a shared root — each node holds its own lease);
         default is a single InMemDisCo shared by every node.
@@ -31,7 +32,11 @@ class LocalCluster:
         drops/delays/flaps into every node's inter-node client — the
         deterministic chaos harness. ``client_factory(i)`` overrides
         client construction per node entirely (it sees the same plan
-        only if it wires one itself)."""
+        only if it wires one itself).
+
+        ``cluster_batch`` attaches the remote-leg coalescer on every
+        node with the given NodeBatcher kwargs ({} for defaults) —
+        equivalent to running under PILOSA_TPU_CLUSTER_BATCH=1."""
         self.disco = InMemDisCo() if disco_factory is None else None
         self.fault_plan = fault_plan
         self.nodes: List[ClusterNode] = []
@@ -49,6 +54,8 @@ class LocalCluster:
                 client = None
             node = ClusterNode(f"node{i}", "", disco, path=path,
                                replica_n=replica_n, client=client)
+            if cluster_batch is not None and node.batcher is None:
+                node.enable_cluster_batch(**cluster_batch)
             srv, _ = serve(node, port=0, background=True)
             host, port = srv.server_address[:2]
             node.node.uri = f"http://{host}:{port}"

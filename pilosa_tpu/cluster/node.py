@@ -34,6 +34,7 @@ from pilosa_tpu.cluster.executor import ClusterExecutor
 from pilosa_tpu.cluster.topology import (
     ClusterSnapshot, Node, STATE_DOWN, STATE_NORMAL,
 )
+from pilosa_tpu.config import env_bool
 from pilosa_tpu.errors import ClusterStateError
 from pilosa_tpu.pql.executor import Executor, _WRITE_CALLS
 from pilosa_tpu.pql.parser import parse
@@ -76,6 +77,11 @@ class ClusterNode:
         # enable_recovery; remote writes landing mid-catch-up queue
         # through it instead of interleaving with shipped-tail replay.
         self._recovery = None
+        # Opt-in fan-out leg batching (cluster/batch.py): the env flag
+        # attaches the coalescer at construction so harness-built
+        # clusters and CI lanes exercise every node batched.
+        if env_bool("PILOSA_TPU_CLUSTER_BATCH"):
+            self.enable_cluster_batch()
 
     # -- topology ----------------------------------------------------------
 
@@ -321,12 +327,45 @@ class ClusterNode:
 
         overrides.setdefault("on_node_up", self._mark_up)
         res = Resilience.from_config(config, **overrides)
+        # breaker-aware keep-alive eviction: a tripped peer's pooled
+        # sockets are suspect (whatever failed it may have wedged its
+        # half of the connections) — drop them so the half-open probe
+        # and recovery traffic reconnect fresh
+        res.breaker.add_listener(self._evict_on_breaker_open)
         self.executor.resilience = res
         self._wire_gossip_resilience()
         return res
 
     def disable_resilience(self) -> None:
         self.executor.resilience = None
+
+    def _evict_on_breaker_open(self, nid: str, frm: str, to: str) -> None:
+        from pilosa_tpu.cluster.resilience import BREAKER_OPEN
+
+        if to == BREAKER_OPEN:
+            self.client.evict_node(nid)
+
+    # -- fan-out leg batching (cluster/batch.py) ---------------------------
+
+    @property
+    def batcher(self):
+        return self.executor.batcher
+
+    def enable_cluster_batch(self, config=None, **overrides):
+        """Attach the per-node remote-leg coalescer: concurrent read
+        legs bound for the same peer ship as ONE multi-query RPC served
+        by the peer's ``execute_many`` superset-merge. While attached,
+        EVERY remote read leg takes the batch RPC (a solo leg ships as
+        a batch of one) so fault injection scoped ``op="query_batch"``
+        covers all batched traffic."""
+        from pilosa_tpu.cluster.batch import NodeBatcher
+
+        batcher = NodeBatcher.from_config(self.client, config, **overrides)
+        self.executor.batcher = batcher
+        return batcher
+
+    def disable_cluster_batch(self) -> None:
+        self.executor.batcher = None
 
     # -- cluster metadata gossip (gossip/) ---------------------------------
 
@@ -443,6 +482,63 @@ class ClusterNode:
         results = self._remote_exec.execute(index, parse(pql), shards=shards)
         self._announce_shards(index)
         return [result_to_wire(r) for r in results]
+
+    def query_remote_batch(self, entries: Sequence[dict]) -> List[dict]:
+        """Serve a coordinator's coalesced node batch (cluster/batch.py
+        -> /internal/query-batch): the whole batch enters the same
+        fusion machinery the coordinator's scheduler uses —
+        ``execute_many`` superset-merges each index group's shard sets
+        into one stacked layout with per-query ``ShardMask``s, so a
+        32-query batch costs one device dispatch here just as it does
+        locally, bit-identical to solo runs.
+
+        Per-entry error slots isolate failures: a batch-level exception
+        re-runs that index group solo, and only the offending entries
+        come back as ``{"error", "status"}`` — their batch-mates keep
+        their results. An attached admission scheduler charges the batch
+        ONE ticket (backpressure sheds whole batches, mapped to 429 by
+        the caller's handler)."""
+        out: List[Optional[dict]] = [None] * len(entries)
+        by_index: Dict[str, List[int]] = {}
+        for i, e in enumerate(entries):
+            by_index.setdefault(str(e.get("index", "")), []).append(i)
+        sched = self.executor.scheduler
+        ticket = sched.admit() if sched is not None else (
+            contextlib.nullcontext())
+        with ticket:
+            for index, slots in by_index.items():
+                self._serve_batch_group(index, entries, slots, out)
+                if any(out[i] is not None and "error" not in out[i]
+                       for i in slots):
+                    self._announce_shards(index)
+        return [o if o is not None else
+                {"error": "batch entry not served", "status": 500}
+                for o in out]
+
+    def _serve_batch_group(self, index: str, entries: Sequence[dict],
+                           slots: List[int],
+                           out: List[Optional[dict]]) -> None:
+        per_shards = [[int(s) for s in (entries[i].get("shards") or [])]
+                      for i in slots]
+        try:
+            queries = [parse(entries[i]["query"]) for i in slots]
+            fused = self._remote_exec.execute_many(
+                index, queries, per_query_shards=per_shards)
+        except Exception:
+            # isolation fallback: solo runs pin errors to their entries
+            for i, shards in zip(slots, per_shards):
+                try:
+                    res = self._remote_exec.execute(
+                        index, parse(entries[i]["query"]), shards=shards)
+                    out[i] = {"results": [result_to_wire(r) for r in res]}
+                except KeyError as exc:
+                    out[i] = {"error": str(exc), "status": 404}
+                except Exception as exc:
+                    out[i] = {"error": f"{type(exc).__name__}: {exc}",
+                              "status": 400}
+            return
+        for i, res in zip(slots, fused):
+            out[i] = {"results": [result_to_wire(r) for r in res]}
 
     # The SQL engine plans against this node's surface, so PQL pushdowns
     # ride the cluster executor (self.executor) and DML routes through

@@ -341,8 +341,8 @@ class FaultPlan:
     at), ``count`` (how many matching indices it stays armed for),
     ``prob`` (seeded per-request probability; omitted = always) and
     ``op`` (scope the rule to one RPC boundary — the client tags
-    "query" / "import" / "translate" / "sql" / "broadcast" / "gossip";
-    omitted = every op). Per-node request indices count ALL ops, so
+    "query" / "query_batch" / "import" / "translate" / "sql" /
+    "broadcast" / "gossip" / "recovery"; omitted = every op). Per-node request indices count ALL ops, so
     op-scoped rules see the same arrival order the wire does. The seed
     defaults to ``PILOSA_TPU_FAULT_SEED`` (0 when unset)."""
 
@@ -655,7 +655,11 @@ class Resilience:
             g = leg.group
             observe(leg, ok=True)
             if g.resolved:
-                return  # loser finished after the race was decided
+                # loser finished after the race was decided: result is
+                # discarded, so the span gets its terminal tag here
+                if leg.token.cancelled:
+                    tag_span(leg, cancelled=True)
+                return
             if not leg.is_hedge:
                 g.resolved = True
                 parts.append(result)
@@ -758,7 +762,11 @@ class Resilience:
                     if err is None:
                         leg_success(leg, fut.result())
                     elif isinstance(err, LegCancelled):
-                        pass  # cancelled loser: no penalty, no result
+                        # cancelled loser: no penalty, no result — but a
+                        # terminal tag, so trace-derived latency
+                        # attribution can drop parked legs instead of
+                        # counting their wait as real node time
+                        tag_span(leg, cancelled=True)
                     elif isinstance(err, NodeDownError):
                         leg_failure(leg, transport=True)
                     else:
@@ -769,5 +777,6 @@ class Resilience:
             # are discarded on arrival
             for leg in active.values():
                 leg.token.cancel()
+                tag_span(leg, cancelled=True)
             pool.shutdown(wait=False)
         return parts, failed

@@ -161,6 +161,19 @@ class Config:
     cluster_resilience_timeout_min_ms: float = 50.0
     cluster_resilience_timeout_max_ms: float = 30000.0
     cluster_resilience_latency_window: int = 64  # rolling samples per node
+    # fan-out leg batching ([cluster.batch] section /
+    # PILOSA_TPU_CLUSTER_BATCH_*): concurrent remote read legs bound for
+    # the same node coalesce into one multi-query RPC (cluster/batch.py;
+    # attach via ClusterNode.enable_cluster_batch, or set
+    # PILOSA_TPU_CLUSTER_BATCH=1 to auto-attach at node construction)
+    cluster_batch_enabled: bool = False
+    cluster_batch_window_ms: float = 0.2  # fixed window when non-adaptive
+    cluster_batch_max_batch: int = 32  # legs per node RPC
+    # adaptive window: EWMA arrival-rate sizing shared with the local
+    # scheduler (sched/window.py), clamped to [window-min, window-max]
+    cluster_batch_adaptive_window: bool = True
+    cluster_batch_window_min_ms: float = 0.05
+    cluster_batch_window_max_ms: float = 2.0
     # crash recovery plane ([storage.recovery] section /
     # PILOSA_TPU_STORAGE_RECOVERY_*): segmented WAL + fuzzy checkpoints +
     # replica catch-up by log shipping (storage/recovery.py; attach

@@ -88,6 +88,16 @@ METRIC_CLUSTER_BREAKER_STATE = "cluster_breaker_state"
 METRIC_CLUSTER_BREAKER_TRANSITIONS = "cluster_breaker_transitions_total"
 METRIC_CLUSTER_LEG_TIMEOUTS = "cluster_leg_timeouts_total"
 METRIC_CLUSTER_LEG_LATENCY = "cluster_leg_latency_ms"
+# coalesced fan-out (cluster/batch.py): legs per batched node RPC
+# (histogram — mean >> 1 is the amortization proof), batch RPCs sent,
+# and per-leg failures delivered out of a batch demux (a per-query
+# remote error or a whole-batch transport failure, labelled why=)
+METRIC_CLUSTER_BATCH_SIZE = "cluster_batch_size"  # histogram
+METRIC_CLUSTER_BATCHED_RPCS = "cluster_batched_rpcs_total"
+METRIC_CLUSTER_BATCH_DEMUX_FAILURES = "cluster_batch_demux_failures_total"
+# batch-size buckets: powers of two up to the default max_batch (32),
+# with one decade above so oversized windows stay visible
+CLUSTER_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 # loopback legs sit ~1-10ms; injected stragglers and WAN legs land in
 # the upper decades
 LEG_LATENCY_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,

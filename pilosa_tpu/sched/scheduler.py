@@ -27,6 +27,7 @@ from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.sched.batch import (GroupKey, execute_batch, fusible_family,
                                     group_key)
 from pilosa_tpu.sched.clock import MonotonicClock
+from pilosa_tpu.sched.window import ArrivalWindow
 
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
@@ -112,9 +113,6 @@ class QueryScheduler:
     the full window so batches fill.
     """
 
-    # EWMA smoothing for arrival gaps; ~universal "last ≈ 5 samples"
-    _EWMA_ALPHA = 0.2
-
     def __init__(self, executor, *, window_ms: float = 0.5,
                  max_batch: int = 64, max_queue: int = 1024,
                  default_deadline_ms: float = 0.0,
@@ -136,8 +134,11 @@ class QueryScheduler:
         self.adaptive_window = bool(adaptive_window)
         self.window_min_s = max(0.0, float(window_min_ms)) / 1e3
         self.window_max_s = max(self.window_min_s, float(window_max_ms) / 1e3)
-        self._gap_ewma: Optional[float] = None
-        self._last_arrival: Optional[float] = None
+        # shared with cluster/batch.py's leg coalescer (sched/window.py)
+        self._arrival = ArrivalWindow(
+            self.window_s, adaptive=self.adaptive_window,
+            window_min_s=self.window_min_s, window_max_s=self.window_max_s,
+            max_batch=self.max_batch)
         self.clock = clock if clock is not None else MonotonicClock()
         self.registry = registry if registry is not None else (
             obs_metrics.REGISTRY)
@@ -302,30 +303,16 @@ class QueryScheduler:
 
     def _observe_arrival(self, now: float) -> None:
         """EWMA of inter-arrival gaps (locked; called from submit)."""
-        last = self._last_arrival
-        self._last_arrival = now
-        if last is None:
-            return
-        gap = max(now - last, 1e-6)
-        if self._gap_ewma is None:
-            self._gap_ewma = gap
-        else:
-            self._gap_ewma += self._EWMA_ALPHA * (gap - self._gap_ewma)
+        self._arrival.observe(now)
 
     def _window_s(self) -> float:
-        """Effective batching window. Adaptive sizing scales with the
-        observed arrival rate: the window earns its full length exactly
-        when a max_batch-sized cohort is expected to arrive within
-        window_max (gap <= window_max / max_batch); an idle stream
-        collapses to window_min so solo queries dispatch promptly."""
+        """Effective batching window; policy shared with the cluster leg
+        coalescer in sched/window.py (full-length window exactly when a
+        max_batch cohort is expected within window_max; idle collapses
+        to window_min so solo queries dispatch promptly)."""
         if not self.adaptive_window:
             return self.window_s
-        gap = self._gap_ewma
-        if gap is None:
-            w = self.window_min_s
-        else:
-            w = self.window_max_s ** 2 / (gap * self.max_batch)
-            w = min(max(w, self.window_min_s), self.window_max_s)
+        w = self._arrival.window_s()
         self.registry.gauge(obs_metrics.METRIC_SCHED_WINDOW_MS, w * 1e3)
         return w
 

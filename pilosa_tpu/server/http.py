@@ -37,6 +37,11 @@ _ROUTES = [
     # node-to-node endpoints (reference: http_handler.go:552-585 /internal/*)
     ("POST", re.compile(r"^/internal/index/([^/]+)/query$"),
      "post_internal_query"),
+    # coalesced multi-query fan-out leg (cluster/batch.py): one RPC
+    # carries many (index, query, shards) legs, served by one fused
+    # superset-merge dispatch per index group
+    ("POST", re.compile(r"^/internal/query-batch$"),
+     "post_internal_query_batch"),
     ("POST", re.compile(r"^/internal/cluster/message$"), "post_cluster_message"),
     # serialized SQL subtree execution (reference: /sql-exec-graph,
     # http_handler.go:538)
@@ -1081,6 +1086,21 @@ class Handler(BaseHTTPRequestHandler):
             index, self._require(b, "query"), b.get("shards") or [])
         self._send(200, self._gossip_reply(peer, {"results": results}))
 
+    def post_internal_query_batch(self):
+        """A coordinator's coalesced node batch (cluster/batch.py):
+        every entry executes against local shards through the fused
+        remote executor, with per-entry error slots so the caller can
+        demux partial failures. Gossip envelope and trace tree piggyback
+        once for the whole batch."""
+        self._node_only()
+        serve_batch = getattr(self.api, "query_remote_batch", None)
+        if serve_batch is None:
+            raise KeyError("peer does not serve query batches")
+        b = self._json_body()
+        peer = self._gossip_apply(b)
+        out = serve_batch(self._require(b, "queries"))
+        self._send(200, self._gossip_reply(peer, {"results": out}))
+
     def post_cluster_message(self):
         self._node_only()
         b = self._json_body()
@@ -1248,6 +1268,11 @@ def serve(api: API, host: str = "127.0.0.1", port: int = 10101,
 
     class _Server(ThreadingHTTPServer):
         maintenance_loop = None
+        # socketserver's default backlog of 5 drops loopback connects
+        # under burst fan-in (a 64-way wave outruns accept()), and an
+        # exhausted-retries connect reads as node death to the fan-out,
+        # which then marks a perfectly live peer down in membership
+        request_queue_size = 128
 
         def server_close(self):  # stop the sweep with the listener
             if self.maintenance_loop is not None:

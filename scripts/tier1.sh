@@ -51,6 +51,19 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== cluster-batch lane (PILOSA_TPU_CLUSTER_BATCH=1, fault seeds) =="
+# The cluster suites re-run with the per-node leg coalescer attached to
+# every node (the env flag ISSUE 9 ships): results must stay
+# bit-identical when every remote read leg rides a multi-query batch
+# RPC, including under the seeded FaultPlan chaos in test_cluster_batch
+# (seeds steer only prob-gated rules, same contract as the fault lane).
+for seed in 1 7; do
+    PILOSA_TPU_CLUSTER_BATCH=1 PILOSA_TPU_FAULT_SEED=$seed \
+        JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_cluster_batch.py tests/test_cluster.py \
+        -q -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== tracing lane (PILOSA_TPU_TRACE=1, sample rate 1.0) =="
 # Every query in these suites runs under a live always-sampling tracer:
 # results must stay bit-identical to the untraced runs above, and the
@@ -76,6 +89,12 @@ echo "== resident warm-vs-cold bench gate (bench.py --configs 13) =="
 # >= 5x below cold, results bit-identical to the non-resident oracle,
 # and no device.h2d_copy stage in any warm query's trace.
 JAX_PLATFORMS=cpu python bench.py --configs 13 || exit $?
+
+echo "== coalesced fan-out bench gate (bench.py --configs 14) =="
+# Hard-asserts the ISSUE 9 acceptance bar in-process: >=8x fewer
+# per-node RPCs at 64-way concurrency with the coalescer on, every
+# result bit-identical to the numpy oracle (including the chaos wave).
+JAX_PLATFORMS=cpu python bench.py --configs 14 || exit $?
 
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
