@@ -1177,6 +1177,77 @@ def bench_config14(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 15 — health-plane overhead on the single-node query path
+# ---------------------------------------------------------------------------
+
+def bench_config15(device: str) -> None:
+    """Health-plane overhead on the single-node query path. Two phases
+    over one fixed workload: plane disabled (the seed default) and the
+    always-on piggyback mode (`PILOSA_TPU_OBS_TIMELINE=1`: SLO
+    accounting per request + cadence-gated timeline samples, zero
+    background threads). Emits p50 per phase and the overhead ratio;
+    like the tracing gate (config 12) the HARD asserts are correctness,
+    not timing: results stay bit-identical, the disabled phase does zero
+    health-plane work, and the enabled phase actually sampled."""
+    from pilosa_tpu.api import API
+    from pilosa_tpu.obs import metrics as M
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(15)
+    api = API()
+    api.create_index("c15")
+    api.create_field("c15", "f")
+    per_shard = _n(40_000)
+    for shard in range(2):
+        rows = rng.integers(0, 8, per_shard)
+        cols = shard * SHARD_WIDTH + np.arange(per_shard)
+        api.import_bits("c15", "f", rows=rows.tolist(), cols=cols.tolist())
+    queries = ["Count(Row(f=3))", "Intersect(Row(f=1), Row(f=2))",
+               "TopN(f, n=4)"]
+
+    def workload() -> list:
+        return [api.query_json("c15", q) for q in queries]
+
+    phases = {}
+    results = {}
+
+    # phase: disabled (the seed default) — no plane object exists, the
+    # query path's only cost is one `is None` check per surface
+    assert api.health is None, "health plane must be off by default"
+    before = M.REGISTRY.value(M.METRIC_TIMELINE_SAMPLES)
+    results["disabled"] = workload()
+    phases["disabled"] = _p50_ms(workload)
+    assert M.REGISTRY.value(M.METRIC_TIMELINE_SAMPLES) == before, \
+        "disabled health plane took timeline samples"
+
+    # phase: always-on piggyback (interval clamped low so the cadence
+    # check actually fires during the run, not just once)
+    hp = api.enable_health(interval_ms=10.0)
+    try:
+        results["always"] = workload()
+        phases["always"] = _p50_ms(workload)
+        sampled = len(hp.timeline)
+        assert sampled > 0, "always-on health plane never sampled"
+        events = {r["surface"]: r["events_fast"]
+                  for r in hp.slo.burn_rates()}
+        assert events.get("query", 0) > 0, \
+            "query surface never reached the SLO tracker"
+    finally:
+        api.disable_health()
+
+    assert results["always"] == results["disabled"], \
+        "health plane changed query results"
+
+    base = phases["disabled"]
+    _emit(f"c15_health_plane_always_on_p50{SCALED} ({device})",
+          phases["always"], "ms", base / max(phases["always"], 1e-9),
+          disabled_ms=base,
+          always_overhead_pct=(phases["always"] / max(base, 1e-9)
+                               - 1.0) * 100.0,
+          timeline_samples=sampled, queries=len(queries))
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -1331,6 +1402,7 @@ _CONFIGS = {
     "12": bench_config12,
     "13": bench_config13,
     "14": bench_config14,
+    "15": bench_config15,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -56,9 +56,23 @@ class API:
         # optional structured query log (reference: server.go:792);
         # set via api.set_query_logger / config query_log_path
         self.query_logger = None
+        # optional cluster health plane (obs/health.py): timeline
+        # sampler + SLO burn tracking + flight recorder. None = the
+        # query/import paths pay one attribute check.
+        self.health = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
             self.holder.recover()
+        from pilosa_tpu.config import env_bool
+        if env_bool("PILOSA_TPU_OBS_TIMELINE"):
+            import os as _os
+            # zero-thread mode: sampling piggybacks on request
+            # accounting, so the whole test suite can run with the
+            # plane live and leak no threads
+            self.enable_health(
+                interval_ms=float(_os.environ.get(
+                    "PILOSA_TPU_OBS_TIMELINE_INTERVAL_MS", "1000")),
+                start=False)
 
     def set_query_logger(self, path: str) -> None:
         from pilosa_tpu.obs.logger import QueryLogger
@@ -117,6 +131,39 @@ class API:
     def disable_cache(self) -> None:
         self.cache = None
         self.executor.cache = None
+
+    # -- health plane (obs/: timeline + SLO + flight recorder) -------------
+
+    def enable_health(self, config=None, start: bool = False, **overrides):
+        """Attach the standing health plane: a timeline ring sampling the
+        metrics registry + live probes, per-surface SLO burn tracking,
+        and the anomaly-triggered flight recorder. ``config`` is a
+        pilosa_tpu.config.Config ([obs.timeline]); kwargs override
+        individual HealthPlane knobs (interval_ms, capacity, clock,
+        objectives, fast_burn_alert, dump_dir, ...). ``start=True`` runs
+        the sampler on a daemon thread; otherwise sampling piggybacks on
+        request accounting (deterministic under an injected clock)."""
+        from pilosa_tpu.obs.health import HealthPlane
+
+        if self.health is not None:
+            self.disable_health()
+        self.health = HealthPlane.from_config(config, **overrides)
+        self.health.attach_api(self)
+        if config is not None and config.obs_timeline_exemplars \
+                and not M.REGISTRY.exemplars:
+            M.REGISTRY.exemplars = True
+            self._health_set_exemplars = True
+        if start:
+            self.health.start()
+        return self.health
+
+    def disable_health(self) -> None:
+        hp, self.health = self.health, None
+        if hp is not None:
+            hp.stop()
+        if getattr(self, "_health_set_exemplars", False):
+            M.REGISTRY.exemplars = False
+            self._health_set_exemplars = False
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -204,12 +251,17 @@ class API:
             if self.query_logger is not None:
                 self.query_logger.log("pql", index, text,
                                       _time.monotonic() - t0)
+            if self.health is not None:
+                self.health.record("query", _time.monotonic() - t0)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
             if self.query_logger is not None:
                 self.query_logger.log("pql", index, text,
                                       _time.monotonic() - t0, error=str(e))
+            if self.health is not None:
+                self.health.record("query", _time.monotonic() - t0,
+                                   error=True)
             raise
         finally:
             span.finish()
@@ -239,17 +291,43 @@ class API:
             if self.query_logger is not None:
                 self.query_logger.log("sql", "", query,
                                       _time.monotonic() - t0)
+            if self.health is not None:
+                self.health.record("sql", _time.monotonic() - t0)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
             if self.query_logger is not None:
                 self.query_logger.log("sql", "", query,
                                       _time.monotonic() - t0, error=str(e))
+            if self.health is not None:
+                self.health.record("sql", _time.monotonic() - t0,
+                                   error=True)
             raise
         finally:
             span.finish()
             self._maybe_slow_log("sql", "", query,
                                  _time.monotonic() - t0, rec)
+
+    def _ingest_slo(self):
+        """SLO accounting scope for the bulk-import surface (no-op when
+        the health plane is off)."""
+        import contextlib
+
+        hp = self.health
+        if hp is None:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def scope():
+            t0 = _time.monotonic()
+            try:
+                yield
+            except Exception:
+                hp.record("ingest", _time.monotonic() - t0, error=True)
+                raise
+            hp.record("ingest", _time.monotonic() - t0)
+
+        return scope()
 
     def _maybe_slow_log(self, kind: str, index: str, text: str,
                         duration_s: float, rec) -> None:
@@ -303,7 +381,7 @@ class API:
             cols = bulk_translate_ids(idx.translate, col_keys)
         if len(rows) != len(cols):
             raise ValueError("rows and cols must be the same length")
-        with self.txf.qcx():
+        with self._ingest_slo(), self.txf.qcx():
             changed = fld.import_bits(rows, cols, clear=clear)
             if not clear and idx.options.track_existence:
                 idx.field("_exists").import_bits(
@@ -330,7 +408,7 @@ class API:
         if len(cols) != len(values):
             raise ValueError("cols and values must be the same length")
         cols = np.asarray(cols, dtype=np.int64)
-        with self.txf.qcx():
+        with self._ingest_slo(), self.txf.qcx():
             fld.set_values(cols, values)
             if idx.options.track_existence:
                 idx.field("_exists").import_bits(

@@ -284,6 +284,13 @@ class ResultCache:
                 "inflight": len(self._inflight),
             }
 
+    def hit_ratio(self) -> float:
+        """Lifetime hits / (hits + misses), 0.0 before any lookup (the
+        health-plane timeline's cache probe)."""
+        with self._lock:
+            total = self._hits + self._misses
+            return (self._hits / total) if total else 0.0
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -452,6 +452,16 @@ class InternalClient:
         return self._post(node, "/internal/gossip/exchange", payload,
                           op="gossip")
 
+    def stats_timeline(self, node, window_s: float = 60.0,
+                       token=None) -> dict:
+        """One peer's local health-plane timeline window (obs/health.py)
+        — the leg GET /internal/stats/cluster's coordinator fan-out
+        merges. Rides the usual retry/fault machinery under
+        ``op="stats"`` so chaos rules can target (or spare) it."""
+        return self._get(
+            node, f"/internal/stats/timeline?window={float(window_s):g}",
+            token=token, op="stats")
+
     def status(self, node) -> Optional[dict]:
         """None when the node is unreachable (used as the liveness probe)."""
         try:

@@ -80,6 +80,12 @@ class LocalCluster:
         ``start=False`` (the default) and drive rounds by hand."""
         return [node.enable_gossip(**kw) for node in self.nodes]
 
+    def enable_health(self, **kw) -> list:
+        """Enable the health plane on every node (ClusterNode.enable_health
+        kwargs pass through — tests usually share one ManualClock via
+        ``clock=``). Returns the planes in node order."""
+        return [node.enable_health(**kw) for node in self.nodes]
+
     def run_gossip_rounds(self, rounds: int = 1) -> int:
         """Drive ``rounds`` synchronous anti-entropy rounds across every
         node (round-robin, node order) — the deterministic stand-in for

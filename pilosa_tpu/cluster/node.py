@@ -82,6 +82,11 @@ class ClusterNode:
         # clusters and CI lanes exercise every node batched.
         if env_bool("PILOSA_TPU_CLUSTER_BATCH"):
             self.enable_cluster_batch()
+        # The env-bootstrapped health plane (API.__init__ honoring
+        # PILOSA_TPU_OBS_TIMELINE=1) only knows the base API; upgrade
+        # its probes to this node's live subsystems.
+        if self.api.health is not None:
+            self.api.health.attach_node(self)
 
     # -- topology ----------------------------------------------------------
 
@@ -216,6 +221,24 @@ class ClusterNode:
               shards: Optional[Sequence[int]] = None,
               priority: Optional[str] = None,
               deadline_ms: Optional[float] = None) -> List[Any]:
+        hp = self.api.health
+        if hp is None:
+            return self._query_impl(index, pql, shards, priority,
+                                    deadline_ms)
+        t0 = time.monotonic()
+        try:
+            out = self._query_impl(index, pql, shards, priority,
+                                   deadline_ms)
+        except Exception:
+            hp.record("query", time.monotonic() - t0, error=True)
+            raise
+        hp.record("query", time.monotonic() - t0)
+        return out
+
+    def _query_impl(self, index: str, pql: str,
+                    shards: Optional[Sequence[int]] = None,
+                    priority: Optional[str] = None,
+                    deadline_ms: Optional[float] = None) -> List[Any]:
         from pilosa_tpu.obs.tracing import get_tracer
 
         q = parse(pql) if isinstance(pql, str) else pql
@@ -334,6 +357,7 @@ class ClusterNode:
         res.breaker.add_listener(self._evict_on_breaker_open)
         self.executor.resilience = res
         self._wire_gossip_resilience()
+        self._wire_health_resilience()
         return res
 
     def disable_resilience(self) -> None:
@@ -344,6 +368,88 @@ class ClusterNode:
 
         if to == BREAKER_OPEN:
             self.client.evict_node(nid)
+
+    # -- health plane (obs/: timeline + SLO + flight recorder) -------------
+
+    @property
+    def health(self):
+        return self.api.health
+
+    def enable_health(self, config=None, start: bool = False, **overrides):
+        """Attach the health plane (see API.enable_health) with this
+        node's live probes: the executor's scheduler/cache, breaker
+        states, and gossip staleness on top of the base WAL/residency
+        reads."""
+        plane = self.api.enable_health(config, start=start, **overrides)
+        plane.attach_node(self)
+        self._wire_health_resilience()
+        return plane
+
+    def disable_health(self) -> None:
+        self.api.disable_health()
+
+    def _wire_health_resilience(self) -> None:
+        """Feed our breaker's LOCAL transitions into the flight
+        recorder's event ring — called from both enable_health and
+        enable_resilience so order doesn't matter. The listener only
+        appends (the breaker notifies under its own lock; capturing a
+        bundle there would read breaker state back and deadlock); the
+        open state fires the ``breaker_open`` trigger at the next
+        timeline sample."""
+        hp = self.api.health
+        res = self.executor.resilience
+        if hp is None or res is None:
+            return
+        old = getattr(self, "_health_listener", None)
+        if old is not None:
+            res.breaker.remove_listener(old)
+        res.breaker.add_listener(hp.on_breaker_transition)
+        self._health_listener = hp.on_breaker_transition
+
+    def cluster_stats(self, window_s: float = 60.0) -> dict:
+        """GET /internal/stats/cluster: fan the timeline window out to
+        every member over the InternalClient (``op="stats"`` — FaultPlan
+        rules scope to it; breaker-open peers are skipped, not probed)
+        and merge: per-node windows plus a cluster aggregate summing
+        each reporting node's newest sample."""
+        from pilosa_tpu.cluster.client import NodeDownError, RemoteError
+        from pilosa_tpu.cluster.resilience import BREAKER_OPEN
+
+        res = self.executor.resilience
+        nodes: Dict[str, dict] = {}
+        for n in self.snapshot().nodes:
+            if n.id == self.node.id:
+                hp = self.api.health
+                nodes[n.id] = (hp.timeline_json(window_s)
+                               if hp is not None else {"enabled": False})
+                continue
+            if res is not None and res.breaker.state(n.id) == BREAKER_OPEN:
+                nodes[n.id] = {"enabled": False, "error": "breaker open"}
+                continue
+            try:
+                nodes[n.id] = self.client.stats_timeline(n, window_s)
+            except (NodeDownError, RemoteError) as e:
+                nodes[n.id] = {"enabled": False, "error": str(e)}
+        rates: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        latest_t = None
+        reporting = 0
+        for tl in nodes.values():
+            samples = tl.get("samples") or []
+            if not tl.get("enabled") or not samples:
+                continue
+            reporting += 1
+            last = samples[-1]
+            latest_t = (last["t"] if latest_t is None
+                        else max(latest_t, last["t"]))
+            for k, v in last.get("rates", {}).items():
+                rates[k] = rates.get(k, 0.0) + v
+            for k, v in last.get("gauges", {}).items():
+                gauges[k] = gauges.get(k, 0.0) + v
+        return {"window_s": window_s, "nodes": nodes,
+                "cluster": {"nodes_reporting": reporting,
+                            "latest_t": latest_t,
+                            "rates": rates, "gauges": gauges}}
 
     # -- fan-out leg batching (cluster/batch.py) ---------------------------
 

@@ -238,6 +238,13 @@ class CircuitBreaker:
         with self._lock:
             return self._slot(node_id).state
 
+    def states(self) -> Dict[str, str]:
+        """Every tracked node's current state (closed slots included) —
+        the health-plane timeline's breaker probe. Read-only: no
+        open->half-open promotion side effects (unlike ``allow``)."""
+        with self._lock:
+            return {nid: s.state for nid, s in sorted(self._slots.items())}
+
     def allow(self, node_id: str) -> bool:
         """May a leg be routed at this node right now? Grants the
         half-open probe as a side effect, so only call when a granted
@@ -342,7 +349,8 @@ class FaultPlan:
     ``prob`` (seeded per-request probability; omitted = always) and
     ``op`` (scope the rule to one RPC boundary — the client tags
     "query" / "query_batch" / "import" / "translate" / "sql" /
-    "broadcast" / "gossip" / "recovery"; omitted = every op). Per-node request indices count ALL ops, so
+    "broadcast" / "gossip" / "recovery" / "stats"; omitted = every
+    op). Per-node request indices count ALL ops, so
     op-scoped rules see the same arrival order the wire does. The seed
     defaults to ``PILOSA_TPU_FAULT_SEED`` (0 when unset)."""
 

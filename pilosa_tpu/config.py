@@ -100,6 +100,22 @@ class Config:
     trace_sample_rate: float = 1.0
     trace_slow_ms: float = 0.0  # <=0: slow-query log off
     trace_store_capacity: int = 256
+    # cluster health plane ([obs.timeline] section — the names flatten
+    # straight to these fields, so env vars read
+    # PILOSA_TPU_OBS_TIMELINE_*; the bare PILOSA_TPU_OBS_TIMELINE=1
+    # switch is honored by API.__init__). Sampler cadence/ring, SLO
+    # burn windows + alert threshold, flight-recorder ring/cooldown,
+    # and the OpenMetrics exemplar flag on /metrics histograms.
+    obs_timeline_enabled: bool = False
+    obs_timeline_interval_ms: float = 1000.0
+    obs_timeline_capacity: int = 300
+    obs_timeline_slo_fast_window_s: float = 300.0
+    obs_timeline_slo_slow_window_s: float = 3600.0
+    obs_timeline_slo_fast_burn_alert: float = 10.0
+    obs_timeline_flight_capacity: int = 16
+    obs_timeline_flight_cooldown_s: float = 30.0
+    obs_timeline_flight_dump_dir: str = ""
+    obs_timeline_exemplars: bool = False
     log_level: str = "info"
     log_path: str = ""
     query_log_path: str = ""  # reference: server.go:792 query logger

@@ -152,6 +152,12 @@ class Holder:
         return sum(idx.wal.record_bytes for idx in self.indexes.values()
                    if idx.wal is not None)
 
+    def wal_flush_lag_s(self) -> float:
+        """Max seconds any index WAL has held unflushed records (0 when
+        every log is clean) — the health plane's WAL-stall probe."""
+        return max((idx.wal.flush_lag_s() for idx in self.indexes.values()
+                    if idx.wal is not None), default=0.0)
+
     def last_lsn(self) -> int:
         """The holder-wide commit position: max LSN assigned across all
         index WALs (each index has its own log, but LSNs only ever

@@ -150,6 +150,22 @@ class GossipState:
         with self._lock:
             return {o: s for o, s in sorted(self._max_seq.items()) if s > 0}
 
+    def origin_ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Seconds since each NON-SELF origin's newest entry landed here
+        — the health-plane staleness read: a partitioned or silent peer's
+        age keeps growing while healthy peers stay near the gossip
+        interval."""
+        if now is None:
+            now = self.clock.now()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for origin, table in self._entries.items():
+                if origin == self.node_id or not table:
+                    continue
+                newest = max(e.stamp for e in table.values())
+                out[origin] = max(0.0, now - newest)
+        return out
+
     def deltas_since(self, peer_digest: Dict[str, int],
                      cap: int = 512) -> List[dict]:
         """Every live entry above the peer's per-origin watermark,

@@ -1,14 +1,21 @@
-"""Observability: metrics, tracing, query history.
+"""Observability: metrics, tracing, query history, health plane.
 
 Reference: metrics.go (prometheus registry, ~70 series), tracing/
 (Tracer/Span facade + nested query profiles, grown here into a
 contextvar-scoped distributed tracer with traceparent propagation),
 tracker.go + systemlayer/ (query-history ring exposed as /query-history
-and SQL system tables).
+and SQL system tables). The health plane (timeline.py + slo.py +
+flight.py, composed by health.py) adds the continuous layer on top:
+a sampled time series of the registry + live probes, per-surface SLO
+burn-rate tracking, and an anomaly-triggered flight recorder.
 """
 
+from pilosa_tpu.obs.flight import FlightRecorder
+from pilosa_tpu.obs.health import HealthPlane
 from pilosa_tpu.obs.history import ExecutionRecord, ExecutionRequestsAPI
 from pilosa_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from pilosa_tpu.obs.slo import Objective, SLOTracker, default_objectives
+from pilosa_tpu.obs.timeline import TimelineSampler, estimate_quantile
 from pilosa_tpu.obs.tracing import (
     NOP_SPAN, NopTracer, Span, TraceStore, Tracer, active_span, configure,
     current_span, current_traceparent, format_traceparent, get_tracer,
@@ -21,4 +28,6 @@ __all__ = [
     "current_span", "active_span", "current_traceparent", "span_scope",
     "format_traceparent", "parse_traceparent",
     "ExecutionRecord", "ExecutionRequestsAPI",
+    "HealthPlane", "TimelineSampler", "SLOTracker", "Objective",
+    "FlightRecorder", "default_objectives", "estimate_quantile",
 ]

@@ -7,11 +7,12 @@ and as the ``fb_exec_requests`` SQL system table.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
 import uuid
-from typing import List, Optional
+from typing import Deque, List, Optional
 
 
 @dataclasses.dataclass
@@ -46,7 +47,10 @@ class ExecutionRequestsAPI:
     def __init__(self, capacity: int = 100):
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._ring: List[ExecutionRecord] = []
+        # deque(maxlen) evicts the oldest record in O(1) on append; the
+        # old list.pop(0) shifted the whole ring on every eviction
+        self._ring: Deque[ExecutionRecord] = collections.deque(
+            maxlen=max(1, capacity))
 
     def begin(self, index: str, query: str, language: str) -> ExecutionRecord:
         rec = ExecutionRecord(
@@ -54,8 +58,6 @@ class ExecutionRequestsAPI:
             language=language, start_time=time.time())
         with self._lock:
             self._ring.append(rec)
-            if len(self._ring) > self.capacity:
-                self._ring.pop(0)
         return rec
 
     def end(self, rec: ExecutionRecord, error: Optional[str] = None) -> None:
@@ -64,9 +66,14 @@ class ExecutionRequestsAPI:
             rec.error = error or ""
             rec.status = "error" if error else "complete"
 
-    def list(self) -> List[ExecutionRecord]:
+    def list(self, limit: Optional[int] = None) -> List[ExecutionRecord]:
+        """Newest first; ``limit`` caps how many records serialize (the
+        ``?n=`` parameter on /query-history)."""
         with self._lock:  # copies: no torn reads of in-flight records
-            return [dataclasses.replace(r) for r in reversed(self._ring)]
+            recs = [dataclasses.replace(r) for r in reversed(self._ring)]
+        if limit is not None:
+            recs = recs[:max(0, int(limit))]
+        return recs
 
     def get(self, request_id: str) -> Optional[ExecutionRecord]:
         with self._lock:

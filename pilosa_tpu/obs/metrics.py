@@ -163,8 +163,28 @@ TRACE_DURATION_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
 METRIC_DEVICE_HBM_RESIDENT_BYTES = "device_hbm_resident_bytes"
 METRIC_DEVICE_STACK_EVICTIONS = "device_stack_evictions_total"
 METRIC_DEVICE_RESIDENT_HITS = "device_resident_hits_total"
+# cluster health plane (obs/timeline.py + slo.py + flight.py): samples
+# appended to the in-memory timeline ring, per-objective error-budget
+# burn rate over the fast/slow windows (gauge {slo=,window=}), and
+# diagnostic bundles the flight recorder captured (labelled trigger=)
+METRIC_TIMELINE_SAMPLES = "timeline_samples_total"
+METRIC_SLO_BURN_RATE = "slo_burn_rate"
+METRIC_FLIGHT_BUNDLES = "flight_bundles_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+# Exemplar source, set by obs.tracing at import (metrics must not import
+# tracing — the dependency runs the other way): returns the active
+# sampled trace ID or None. Registries opt in per-instance (`exemplars`);
+# the hook alone records nothing.
+_EXEMPLAR_PROVIDER = None
+
+
+def set_exemplar_provider(fn) -> None:
+    """Install the callable `observe_bucketed` asks for the active trace
+    ID (``() -> Optional[str]``). Pass None to detach."""
+    global _EXEMPLAR_PROVIDER
+    _EXEMPLAR_PROVIDER = fn
 
 
 class MetricsRegistry:
@@ -172,14 +192,19 @@ class MetricsRegistry:
     _sum, enough for rate+mean dashboards; the reference's prometheus
     client keeps quantiles we don't need for parity of names)."""
 
-    def __init__(self, namespace: str = "pilosa"):
+    def __init__(self, namespace: str = "pilosa",
+                 exemplars: bool = False):
         self.namespace = namespace
+        self.exemplars = exemplars
         self._lock = threading.Lock()
         self._counters: Dict[_Key, float] = {}
         self._gauges: Dict[_Key, float] = {}
         self._summaries: Dict[_Key, Tuple[int, float]] = {}
         # histogram: [buckets, per-bucket counts (+overflow), sum, count]
         self._histograms: Dict[_Key, list] = {}
+        # per-series latest exemplar per bucket index:
+        # {series_key: {bucket_idx: (trace_id, value, unix_ts)}}
+        self._exemplars: Dict[_Key, Dict[int, Tuple[str, float, float]]] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[dict]) -> _Key:
@@ -201,11 +226,18 @@ class MetricsRegistry:
             self._summaries[k] = (c + 1, s + seconds)
 
     def observe_bucketed(self, name: str, value: float,
-                         buckets: Tuple[float, ...], **labels) -> None:
+                         buckets: Tuple[float, ...],
+                         exemplar_trace_id: Optional[str] = None,
+                         **labels) -> None:
         """Histogram observation with explicit upper bounds (Prometheus
         ``le`` semantics: a value lands in the first bucket whose bound
         is >= value; beyond the last bound it only counts toward +Inf).
-        The bucket layout is fixed by the first observation of a series."""
+        The bucket layout is fixed by the first observation of a series.
+
+        ``exemplar_trace_id`` pins the exemplar for call sites that run
+        outside the span scope (the tracer's finish hooks observe the
+        duration histograms AFTER the contextvar is reset); otherwise
+        the registered provider supplies the active trace ID."""
         import bisect
 
         k = self._key(name, labels)
@@ -215,9 +247,17 @@ class MetricsRegistry:
                 bs = tuple(sorted(float(b) for b in buckets))
                 h = [bs, [0] * (len(bs) + 1), 0.0, 0]
                 self._histograms[k] = h
-            h[1][bisect.bisect_left(h[0], value)] += 1
+            idx = bisect.bisect_left(h[0], value)
+            h[1][idx] += 1
             h[2] += value
             h[3] += 1
+            if self.exemplars:
+                tid = exemplar_trace_id
+                if tid is None and _EXEMPLAR_PROVIDER is not None:
+                    tid = _EXEMPLAR_PROVIDER()
+                if tid:
+                    self._exemplars.setdefault(k, {})[idx] = (
+                        tid, value, time.time())
 
     def histogram(self, name: str, **labels) -> Optional[dict]:
         """Snapshot of one histogram series (None if never observed)."""
@@ -263,13 +303,42 @@ class MetricsRegistry:
             self._gauges.clear()
             self._summaries.clear()
             self._histograms.clear()
+            self._exemplars.clear()
+
+    def snapshot(self) -> dict:
+        """One consistent point-in-time copy of every series, keyed by
+        formatted series name — what the timeline sampler diffs between
+        cadence ticks (counters -> rates, histograms -> quantiles)."""
+        with self._lock:
+            return {
+                "counters": {f"{n}{self._fmt_labels(l)}": v
+                             for (n, l), v in self._counters.items()},
+                "gauges": {f"{n}{self._fmt_labels(l)}": v
+                           for (n, l), v in self._gauges.items()},
+                "histograms": {
+                    f"{n}{self._fmt_labels(l)}": {
+                        "bounds": list(h[0]), "counts": list(h[1]),
+                        "sum": h[2], "count": h[3],
+                    }
+                    for (n, l), h in self._histograms.items()
+                },
+            }
 
     # -- exposition --------------------------------------------------------
+
+    @staticmethod
+    def _escape_label_value(v) -> str:
+        # Prometheus text-format spec: label values escape backslash,
+        # double-quote, and line-feed (query text and error strings
+        # routinely contain all three)
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
 
     def _fmt_labels(self, labels: Tuple[Tuple[str, str], ...]) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(f'{k}="{self._escape_label_value(v)}"'
+                         for k, v in labels)
         return "{" + inner + "}"
 
     def prometheus_text(self) -> str:
@@ -292,13 +361,25 @@ class MetricsRegistry:
             for (name, labels), h in sorted(self._histograms.items()):
                 out.append(f"# TYPE {ns}_{name} histogram")
                 bs, counts, total, n = h
+                ex = self._exemplars.get((name, labels), {})
                 cum = 0
-                for ub, c in zip(bs, counts):
+                for i, (ub, c) in enumerate(zip(bs, counts)):
                     cum += c
                     lbl = self._fmt_labels(labels + (("le", f"{ub:g}"),))
-                    out.append(f"{ns}_{name}_bucket{lbl} {cum}")
+                    line = f"{ns}_{name}_bucket{lbl} {cum}"
+                    if self.exemplars and i in ex:
+                        tid, val, ts = ex[i]
+                        # OpenMetrics exemplar: links this bucket to the
+                        # trace that landed in it (/internal/traces/{id})
+                        line += (f' # {{trace_id="{tid}"}} {val:g}'
+                                 f" {ts:.3f}")
+                    out.append(line)
                 lbl = self._fmt_labels(labels + (("le", "+Inf"),))
-                out.append(f"{ns}_{name}_bucket{lbl} {n}")
+                line = f"{ns}_{name}_bucket{lbl} {n}"
+                if self.exemplars and len(bs) in ex:
+                    tid, val, ts = ex[len(bs)]
+                    line += f' # {{trace_id="{tid}"}} {val:g} {ts:.3f}'
+                out.append(line)
                 lbl = self._fmt_labels(labels)
                 out.append(f"{ns}_{name}_sum{lbl} {total}")
                 out.append(f"{ns}_{name}_count{lbl} {n}")

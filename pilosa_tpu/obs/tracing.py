@@ -376,13 +376,19 @@ class Tracer:
     def _finish_root(self, span: Span) -> None:
         dur_ms = (span.duration_s or 0.0) * 1e3
         self.registry.count(M.METRIC_TRACE_FINISHED)
+        # finish runs after the contextvar scope is reset, so the
+        # exemplar trace ID is passed explicitly (the provider would
+        # see no current span here)
+        tid = span.trace_id if span.sampled else None
         self.registry.observe_bucketed(
-            M.METRIC_TRACE_DURATION, dur_ms, M.TRACE_DURATION_BUCKETS_MS)
-        self._observe_stages(span)
+            M.METRIC_TRACE_DURATION, dur_ms, M.TRACE_DURATION_BUCKETS_MS,
+            exemplar_trace_id=tid)
+        self._observe_stages(span, tid)
         if self.store is not None:
             self.store.add(span)
 
-    def _observe_stages(self, span: Span) -> None:
+    def _observe_stages(self, span: Span,
+                        trace_id: Optional[str] = None) -> None:
         stack = list(span.children)
         while stack:
             c = stack.pop()
@@ -390,7 +396,8 @@ class Tracer:
                 continue
             self.registry.observe_bucketed(
                 M.METRIC_TRACE_STAGE_LATENCY, (c.duration_s or 0.0) * 1e3,
-                M.TRACE_DURATION_BUCKETS_MS, stage=c.name)
+                M.TRACE_DURATION_BUCKETS_MS, stage=c.name,
+                exemplar_trace_id=trace_id)
             stack.extend(c.children)
 
 
@@ -440,3 +447,12 @@ def _env_bootstrap() -> None:
 
 
 _env_bootstrap()
+
+def _exemplar_trace_id():
+    """Active sampled trace ID or None — the metrics registry's exemplar
+    source (wired here because metrics must not import tracing)."""
+    sp = _CURRENT.get()
+    return sp.trace_id if sp is not None and sp.sampled else None
+
+
+M.set_exemplar_provider(_exemplar_trace_id)

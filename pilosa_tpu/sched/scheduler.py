@@ -497,6 +497,14 @@ class QueryScheduler:
         with self._lock:
             return len(self._queue)
 
+    def stats(self) -> dict:
+        """One consistent queue/admission snapshot (the health-plane
+        timeline's scheduler probe)."""
+        with self._lock:
+            return {"queue_depth": len(self._queue),
+                    "inflight_admits": self._inflight_admits,
+                    "max_queue": self.max_queue}
+
     def close(self) -> None:
         with self._cv:
             self._closed = True

@@ -137,6 +137,14 @@ _ROUTES = [
     # assembled span tree per trace id
     ("GET", re.compile(r"^/internal/traces$"), "get_internal_traces"),
     ("GET", re.compile(r"^/internal/traces/([^/]+)$"), "get_internal_trace"),
+    # health plane (obs/health.py): local timeline window, cluster-wide
+    # fan-out merge, SLO burn status, flight-recorder bundles
+    ("GET", re.compile(r"^/internal/stats/timeline$"), "get_stats_timeline"),
+    ("GET", re.compile(r"^/internal/stats/cluster$"), "get_stats_cluster"),
+    ("GET", re.compile(r"^/internal/slo$"), "get_slo"),
+    ("GET", re.compile(r"^/internal/debug/bundles$"), "get_debug_bundles"),
+    ("GET", re.compile(r"^/internal/debug/bundles/([^/]+)$"),
+     "get_debug_bundle"),
     ("GET", re.compile(r"^/index/([^/]+)/mutex-check$"), "get_mutex_check"),
     # DAX directive push (reference: dax computer /directive endpoint)
     ("POST", re.compile(r"^/directive$"), "post_directive"),
@@ -574,7 +582,82 @@ class Handler(BaseHTTPRequestHandler):
         self._send(200, REGISTRY.as_json())
 
     def get_query_history(self):
-        self._send(200, [r.to_json() for r in self.api.history.list()])
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(self.path).query)
+        limit = None
+        if "n" in qs:
+            try:
+                limit = int(qs["n"][0])
+            except ValueError:
+                self._send(400, {"error": "n must be an integer"})
+                return
+        self._send(200, [r.to_json()
+                         for r in self.api.history.list(limit=limit)])
+
+    # -- health plane (obs/health.py) --------------------------------------
+
+    def _health_plane(self):
+        return getattr(self.api, "health", None)
+
+    def _window_param(self, default=None):
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(self.path).query)
+        if "window" not in qs:
+            return default
+        return float(qs["window"][0])
+
+    def get_stats_timeline(self):
+        hp = self._health_plane()
+        if hp is None:
+            self._send(200, {"enabled": False})
+            return
+        try:
+            window = self._window_param()
+        except ValueError:
+            self._send(400, {"error": "window must be a number"})
+            return
+        self._send(200, hp.timeline_json(window))
+
+    def get_stats_cluster(self):
+        try:
+            window = self._window_param(default=60.0)
+        except ValueError:
+            self._send(400, {"error": "window must be a number"})
+            return
+        fanout = getattr(self.api, "cluster_stats", None)
+        if fanout is not None:
+            self._send(200, fanout(window))
+            return
+        # single-node API: the "cluster" is just us
+        hp = self._health_plane()
+        local = (hp.timeline_json(window) if hp is not None
+                 else {"enabled": False})
+        self._send(200, {"window_s": window, "nodes": {"local": local},
+                         "cluster": {"nodes_reporting":
+                                     1 if hp is not None else 0}})
+
+    def get_slo(self):
+        hp = self._health_plane()
+        if hp is None:
+            self._send(200, {"enabled": False})
+            return
+        self._send(200, {"enabled": True, **hp.slo.status()})
+
+    def get_debug_bundles(self):
+        hp = self._health_plane()
+        if hp is None:
+            self._send(200, {"enabled": False, "bundles": []})
+            return
+        self._send(200, {"enabled": True,
+                         "bundles": hp.flight.summaries()})
+
+    def get_debug_bundle(self, bundle_id: str):
+        hp = self._health_plane()
+        if hp is None:
+            raise KeyError("health plane disabled (enable [obs.timeline])")
+        self._send(200, hp.flight.get(bundle_id))  # KeyError -> 404
 
     def get_internal_traces(self):
         """Newest-first summaries of finished traces (the span trees stay

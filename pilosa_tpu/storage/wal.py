@@ -42,6 +42,7 @@ import pickle
 import re
 import struct
 import threading
+import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -151,6 +152,9 @@ class WAL:
         self._lsn = 0
         self._segments: List[_Segment] = []
         self._dirty = False
+        # monotonic stamp of the oldest append still awaiting its write
+        # barrier (None when clean) — the health plane's WAL-stall read
+        self._dirty_since: Optional[float] = None
         self._open_existing()
 
     # -- open / segments -----------------------------------------------------
@@ -268,6 +272,8 @@ class WAL:
             seg = self._segments[-1]
             seg.record_bytes += len(framed)
             seg.max_lsn = lsn
+            if not self._dirty:
+                self._dirty_since = time.monotonic()
             self._dirty = True
             if self.sync == "always":
                 self._flush_locked()
@@ -282,6 +288,16 @@ class WAL:
         if self.sync != "never":
             os.fsync(self._f.fileno())
         self._dirty = False
+        self._dirty_since = None
+
+    def flush_lag_s(self) -> float:
+        """Seconds the oldest unflushed append has waited for a write
+        barrier (0 when clean) — a stall here means a group commit is
+        stuck, the flight recorder's ``wal_stall`` trigger."""
+        with self._lock:
+            if self._dirty_since is None:
+                return 0.0
+            return max(0.0, time.monotonic() - self._dirty_since)
 
     def flush(self) -> None:
         """Group commit: one write barrier for everything appended since

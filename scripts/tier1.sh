@@ -74,6 +74,19 @@ PILOSA_TPU_TRACE=1 PILOSA_TPU_TRACE_SAMPLE_RATE=1.0 JAX_PLATFORMS=cpu \
     tests/test_cache.py tests/test_tracing.py -q -p no:cacheprovider \
     -p no:xdist -p no:randomly || exit $?
 
+echo "== obs-timeline lane (PILOSA_TPU_OBS_TIMELINE=1, 10ms cadence) =="
+# The health plane rides every API/node in these suites in piggyback
+# mode (SLO accounting per request, cadence-gated timeline samples,
+# zero background threads); the clamped interval forces the sampler,
+# burn-rate evaluation, and flight-recorder trigger paths to actually
+# fire under the full tracing/cluster/scheduler suites while results
+# stay bit-identical.
+PILOSA_TPU_OBS_TIMELINE=1 PILOSA_TPU_OBS_TIMELINE_INTERVAL_MS=10 \
+    JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_tracing.py tests/test_cluster.py \
+    tests/test_sched.py tests/test_health.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
 echo "== device-budget lane (PILOSA_TPU_DEVICE_BUDGET clamped) =="
 # The residency plane must stay correct when HBM is scarce: an 8MB cap
 # with 4MB blocks forces paging AND eviction of resident planes on the
@@ -95,6 +108,12 @@ echo "== coalesced fan-out bench gate (bench.py --configs 14) =="
 # per-node RPCs at 64-way concurrency with the coalescer on, every
 # result bit-identical to the numpy oracle (including the chaos wave).
 JAX_PLATFORMS=cpu python bench.py --configs 14 || exit $?
+
+echo "== health-plane overhead bench gate (bench.py --configs 15) =="
+# Hard-asserts the ISSUE 10 acceptance bar in-process: bit-identical
+# results with the always-on piggyback plane, zero health-plane work
+# when disabled, and the sampler actually firing when enabled.
+JAX_PLATFORMS=cpu python bench.py --configs 15 || exit $?
 
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
