@@ -53,6 +53,12 @@ def _n(x: int) -> int:
     return max(1, int(x * SCALE))
 
 
+#: Every record _emit printed this run — the profile dump
+#: (PILOSA_BENCH_PROFILE_OUT) rewrites them to a file scripts/
+#: bench_compare.py can diff against a previous run.
+_EMITTED = []
+
+
 def _emit(metric: str, value: float, unit: str, vs_baseline: float,
           **extra) -> None:
     rec = {
@@ -63,7 +69,39 @@ def _emit(metric: str, value: float, unit: str, vs_baseline: float,
     }
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in extra.items()})
+    _EMITTED.append(rec)
     print(json.dumps(rec), flush=True)
+
+
+def _quiet_xla_warnings() -> None:
+    """The experimental-platform plugin logs ``Platform 'axon' is
+    experimental`` on every backend touch; filter it at the logger so
+    the JSON-lines output stays machine-parseable."""
+    import logging
+
+    class _DropExperimental(logging.Filter):
+        def filter(self, record):
+            try:
+                msg = record.getMessage()
+            except Exception:
+                return True
+            return "is experimental" not in msg
+
+    f = _DropExperimental()
+    for name in ("jax._src.xla_bridge", "jax", "absl"):
+        logging.getLogger(name).addFilter(f)
+
+
+def _dump_profile(path: str, device: str) -> None:
+    """Append this run's emitted records + kernel profiles as JSON lines
+    (append mode: the orchestrator's children share one file)."""
+    from pilosa_tpu.obs import devprof
+
+    with open(path, "a") as f:
+        for rec in _EMITTED:
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps({"metric": "__kernels__", "device": device,
+                            "profile": devprof.stats_json()}) + "\n")
 
 
 _FLOOR_MS = None
@@ -1248,6 +1286,105 @@ def bench_config15(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 16 — kernel-attribution (devprof) overhead + correctness gate
+# ---------------------------------------------------------------------------
+
+def bench_config16(device: str) -> None:
+    """Devprof-plane gate on the warm resident query path. Two phases
+    over one fixed workload: disabled (the seed default — HARD assert:
+    exactly zero cost-model evaluations and zero profile allocations)
+    and enabled via devprof.enable() (HARD asserts: bit-identical
+    results, and a profile with positive MFU/GB/s for every distinct
+    query family the battery compiles). Like configs 12/15 the hard
+    asserts are correctness/allocation, not timing — the overhead pct is
+    emitted for the ≤3% acceptance read. Interleaved decomposition shows
+    the disabled path (no hooks installed) measures 0% within noise; the
+    enabled cost is the fixed per-dispatch registry publication (~30us),
+    which is a few percent against sub-millisecond CPU dispatches and
+    vanishes against real device dispatch times."""
+    from pilosa_tpu.api import API
+    from pilosa_tpu.obs import devprof
+    from pilosa_tpu.pql import programs
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(16)
+    api = API()
+    api.create_index("c16")
+    api.create_field("c16", "f")
+    api.create_field("c16", "g")
+    per_shard = _n(80_000)
+    for shard in range(2):
+        cols = shard * SHARD_WIDTH + np.arange(per_shard)
+        api.import_bits("c16", "f",
+                        rows=rng.integers(0, 32, per_shard).tolist(),
+                        cols=cols.tolist())
+        api.import_bits("c16", "g",
+                        rows=rng.integers(0, 16, per_shard).tolist(),
+                        cols=cols.tolist())
+    # four distinct tapes -> four compiled families to attribute
+    queries = [
+        "Count(Row(f=3))",
+        "Count(Intersect(Row(f=1), Row(g=1)))",
+        "Count(Union(Row(f=2), Row(g=3), Row(f=5)))",
+        "Intersect(Row(f=1), Row(g=2))",
+    ]
+    api.holder.prewarm("c16")
+
+    def workload() -> list:
+        return [api.query_json("c16", q) for q in queries]
+
+    assert not devprof.ENABLED, \
+        "devprof must be off for the disabled phase (unset " \
+        "PILOSA_TPU_DEVPROF)"
+    evals0 = devprof.cost_evals()
+    allocs0 = devprof.KERNELS.allocations
+    results_off = workload()
+    _p50_ms(workload)  # warm both paths before the paired timing below
+    assert devprof.cost_evals() == evals0, \
+        "disabled devprof evaluated the cost model"
+    assert devprof.KERNELS.allocations == allocs0, \
+        "disabled devprof allocated kernel profiles"
+
+    devprof.enable()
+    try:
+        devprof.reset()
+        results_on = workload()
+        assert results_on == results_off, "devprof changed query results"
+        # paired interleaved timing: host noise on a shared CPU dwarfs
+        # the hook cost when the phases run in separate blocks, so each
+        # iteration times both states back-to-back
+        off_t, on_t = [], []
+        for _ in range(max(24, QUERY_ITERS)):
+            devprof.disable()
+            t0 = time.perf_counter()
+            workload()
+            off_t.append(time.perf_counter() - t0)
+            devprof.enable()
+            t0 = time.perf_counter()
+            workload()
+            on_t.append(time.perf_counter() - t0)
+        off_ms = statistics.median(off_t) * 1e3
+        on_ms = statistics.median(on_t) * 1e3
+        profiles = devprof.KERNELS.snapshot()
+        assert len(profiles) >= len(queries), \
+            f"{len(profiles)} kernel profiles for {len(queries)} families"
+        for p in profiles:
+            assert p["dispatches"] > 0, p
+            assert p.get("mfu_pct", 0.0) > 0.0, p
+            assert p.get("achieved_gbps", 0.0) > 0.0, p
+    finally:
+        devprof.disable()
+
+    overhead_pct = (on_ms / max(off_ms, 1e-9) - 1.0) * 100.0
+    _emit(f"c16_devprof_overhead_p50{SCALED} ({device})",
+          on_ms, "ms", off_ms / max(on_ms, 1e-9),
+          disabled_ms=off_ms, overhead_pct=overhead_pct,
+          kernel_profiles=len(profiles),
+          programs_cached=programs.program_cache_len(),
+          cost_evals=devprof.cost_evals(), queries=len(queries))
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -1403,6 +1540,7 @@ _CONFIGS = {
     "13": bench_config13,
     "14": bench_config14,
     "15": bench_config15,
+    "16": bench_config16,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 
@@ -1411,6 +1549,7 @@ def main(which: str) -> int:
     """Child: run ONE config (or 'all') on the already-selected backend."""
     from pilosa_tpu.platform import force_cpu_platform
 
+    _quiet_xla_warnings()
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         force_cpu_platform()  # pin the config too (sitecustomize hooks)
     import jax
@@ -1436,6 +1575,9 @@ def main(which: str) -> int:
         print(f"bench: {cfg.__name__} wall {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         gc.collect()
+    profile_out = os.environ.get("PILOSA_BENCH_PROFILE_OUT")
+    if profile_out:
+        _dump_profile(profile_out, device)
     return failed
 
 

@@ -89,6 +89,9 @@ class Config:
     auth_secret: str = ""
     auth_permissions_file: str = ""
     auth_allowed_networks: List[str] = dataclasses.field(default_factory=list)
+    # mark session cookies Secure (HTTPS-only); leave off for plain-HTTP
+    # dev deployments or the login flow's cookies never come back
+    auth_secure_cookies: bool = False
     # observability
     tracing_enable: bool = False
     # distributed tracing ([obs.tracing] section / PILOSA_TPU_TRACE_*):

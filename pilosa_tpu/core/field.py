@@ -11,11 +11,13 @@ from __future__ import annotations
 
 import datetime as dt
 import os
+import time
 from typing import Dict, Iterable, List, Optional, Set
 
 import numpy as np
 
 from pilosa_tpu.core import timeq
+from pilosa_tpu.obs import devprof
 from pilosa_tpu.core.fragment import BSIFragment, SetFragment, group_sorted
 from pilosa_tpu.core.schema import (
     BOOL_FALSE_ROW,
@@ -190,6 +192,19 @@ class Field:
         return out - self.options.base
 
     def set_values(self, cols: Iterable[int], values: Iterable) -> None:
+        if not devprof.ENABLED:
+            return self._set_values(cols, values)
+        if not isinstance(cols, (list, tuple, np.ndarray)):
+            cols = list(cols)
+        t0 = time.perf_counter()
+        out = self._set_values(cols, values)
+        # "fragment advance": WAL append buffering + per-shard fragment
+        # writes for one bulk call — the device-side half of ingest
+        devprof.record_stage("fragment_advance", time.perf_counter() - t0,
+                             rows=len(cols))
+        return out
+
+    def _set_values(self, cols: Iterable[int], values: Iterable) -> None:
         if not isinstance(cols, (list, tuple, np.ndarray)):
             cols = list(cols)  # generators/iterators per the signature
         cols = np.asarray(cols, dtype=np.int64).ravel()
@@ -219,6 +234,18 @@ class Field:
         """Bulk (row, col) import with IDs already translated (reference:
         fragment.go:1498 bulkImport; mutex variant :1787). Returns changed
         bit count. The one bulk WAL record replaces per-bit logging."""
+        if not devprof.ENABLED:
+            return self._import_bits(rows, cols, clear)
+        if not isinstance(cols, (list, tuple, np.ndarray)):
+            cols = list(cols)
+        t0 = time.perf_counter()
+        changed = self._import_bits(rows, cols, clear)
+        devprof.record_stage("fragment_advance", time.perf_counter() - t0,
+                             rows=len(cols))
+        return changed
+
+    def _import_bits(self, rows: Iterable[int], cols: Iterable[int],
+                     clear: bool = False) -> int:
         if not isinstance(rows, (list, tuple, np.ndarray)):
             rows = list(rows)  # generators/iterators per the signature
         if not isinstance(cols, (list, tuple, np.ndarray)):

@@ -65,7 +65,8 @@ def cmd_server(args) -> int:
         if not cfg.auth_secret:
             raise SystemExit("auth.enable requires auth.secret")
         auth = Auth(cfg.auth_secret, perms,
-                    allowed_networks=cfg.auth_allowed_networks)
+                    allowed_networks=cfg.auth_allowed_networks,
+                    secure_cookies=cfg.auth_secure_cookies)
     print(f"pilosa-tpu serving on {cfg.bind}:{cfg.port} "
           f"(data-dir={cfg.data_dir or '<memory>'}"
           f"{', auth on' if auth else ''})", file=sys.stderr)

@@ -131,11 +131,16 @@ class HealthPlane:
     # -- attachment --------------------------------------------------------
 
     def attach_api(self, api) -> None:
+        from pilosa_tpu.obs import devprof
+
         self.timeline.add_probe("scheduler", lambda: _sched_probe(api))
         self.timeline.add_probe("cache", lambda: _cache_probe(api))
         self.timeline.add_probe("wal", lambda: _wal_probe(api.holder))
         self.timeline.add_probe("residency",
                                 lambda: api.holder.residency_stats())
+        # kernel profiles ride every timeline sample, so flight-recorder
+        # bundles capture MFU/roofline state at anomaly time
+        self.timeline.add_probe("kernels", devprof.timeline_probe)
 
     def attach_node(self, node) -> None:
         """Upgrade probes to the cluster node's live subsystems (the

@@ -170,6 +170,37 @@ METRIC_DEVICE_RESIDENT_HITS = "device_resident_hits_total"
 METRIC_TIMELINE_SAMPLES = "timeline_samples_total"
 METRIC_SLO_BURN_RATE = "slo_burn_rate"
 METRIC_FLIGHT_BUNDLES = "flight_bundles_total"
+# kernel performance attribution plane (obs/devprof.py): the analytic
+# FLOP/byte cost model over the compiled op tapes. Counters accumulate
+# per-family dispatches / device seconds / bit-op FLOPs / HBM bytes
+# (labelled family=<tape signature>); the gauges are the derived
+# achieved-vs-peak reads (MFU as a percentage of the backend peak table,
+# achieved GB/s); the histogram is per-dispatch device time with trace
+# exemplars; h2d_* account every platform.h2d_copy byte
+METRIC_KERNEL_DISPATCHES = "device_kernel_dispatches_total"
+METRIC_KERNEL_DEVICE_SECONDS = "device_kernel_device_seconds_total"
+METRIC_KERNEL_FLOPS = "device_kernel_flops_total"
+METRIC_KERNEL_HBM_BYTES = "device_kernel_hbm_bytes_total"
+METRIC_KERNEL_MFU_PCT = "device_kernel_mfu_pct"
+METRIC_KERNEL_GBPS = "device_kernel_achieved_gbps"
+METRIC_KERNEL_DISPATCH_US = "device_kernel_dispatch_us"  # histogram
+METRIC_KERNEL_H2D_BYTES = "device_kernel_h2d_bytes_total"
+METRIC_KERNEL_H2D_SECONDS = "device_kernel_h2d_seconds_total"
+# a warm compiled-tape dispatch is tens of µs of launch overhead on CPU
+# up through multi-ms sharded collectives; cold paths land in the tail
+KERNEL_DISPATCH_BUCKETS_US = (50.0, 100.0, 250.0, 500.0, 1000.0,
+                              2500.0, 5000.0, 10000.0, 25000.0,
+                              100000.0, 500000.0)
+# ingest stage accounting (ingest/ + storage/wal.py via obs/devprof.py):
+# per-stage wall seconds / rows / bytes counters and the derived
+# cumulative rows-per-s / bytes-per-s gauges, labelled
+# stage=parse|key_translate|h2d_copy|fragment_advance|wal_commit — the
+# overlap work reads these to see which stage hides which
+METRIC_INGEST_STAGE_SECONDS = "ingest_stage_seconds_total"
+METRIC_INGEST_STAGE_ROWS = "ingest_stage_rows_total"
+METRIC_INGEST_STAGE_BYTES = "ingest_stage_bytes_total"
+METRIC_INGEST_STAGE_ROWS_PER_S = "ingest_stage_rows_per_s"
+METRIC_INGEST_STAGE_BYTES_PER_S = "ingest_stage_bytes_per_s"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -14,6 +14,7 @@ import contextlib
 import os
 import re
 import threading
+import time
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
 
@@ -99,6 +100,22 @@ _DISPATCH_LOCK = threading.RLock()
 _NULL_GUARD = contextlib.nullcontext()
 _GUARD_IS_LOCK: bool | None = None
 
+# Kernel-profiling hooks (obs/devprof.py installs these while the
+# devprof plane is enabled; None means the un-instrumented fast path —
+# guarded_call/h2d_copy do no extra work at all). The dispatch hook
+# receives (dispatch_s, block_s) wall times, the h2d hook
+# (nbytes, seconds); both are invoked AFTER the dispatch guard is
+# released, so the leaf-lock rule is untouched.
+_DISPATCH_HOOK = None
+_H2D_HOOK = None
+
+
+def set_profile_hooks(dispatch_hook, h2d_hook) -> None:
+    """Install (or with None, remove) the kernel-profiling callbacks."""
+    global _DISPATCH_HOOK, _H2D_HOOK
+    _DISPATCH_HOOK = dispatch_hook
+    _H2D_HOOK = h2d_hook
+
 
 def dispatch_guard():
     """Context manager serializing sharded-executable launches across
@@ -149,11 +166,22 @@ def h2d_copy(host, sharding=None):
     from pilosa_tpu.obs.tracing import get_tracer
 
     arr = np.asarray(host)
+    hook = _H2D_HOOK
+    if hook is None:
+        with dispatch_guard():
+            with get_tracer().start_span("device.h2d_copy",
+                                         nbytes=arr.nbytes):
+                if sharding is not None:
+                    return jax.device_put(arr, sharding)
+                return jax.device_put(arr)
     with dispatch_guard():
         with get_tracer().start_span("device.h2d_copy", nbytes=arr.nbytes):
-            if sharding is not None:
-                return jax.device_put(arr, sharding)
-            return jax.device_put(arr)
+            t0 = time.perf_counter()
+            out = (jax.device_put(arr, sharding) if sharding is not None
+                   else jax.device_put(arr))
+            dt = time.perf_counter() - t0
+    hook(arr.nbytes, dt)
+    return out
 
 
 def guarded_call(fn):
@@ -173,15 +201,30 @@ def guarded_call(fn):
     def call(*args, **kwargs):
         guard = dispatch_guard()
         tracer = get_tracer()
+        hook = _DISPATCH_HOOK
+        if hook is None:
+            with guard:
+                with tracer.start_span("device.dispatch"):
+                    out = fn(*args, **kwargs)
+                if guard is _DISPATCH_LOCK:
+                    import jax
+
+                    with tracer.start_span("device.block_until_ready"):
+                        jax.block_until_ready(out)
+                return out
         with guard:
+            t0 = time.perf_counter()
             with tracer.start_span("device.dispatch"):
                 out = fn(*args, **kwargs)
+            t1 = time.perf_counter()
             if guard is _DISPATCH_LOCK:
                 import jax
 
                 with tracer.start_span("device.block_until_ready"):
                     jax.block_until_ready(out)
-            return out
+            t2 = time.perf_counter()
+        hook(t1 - t0, t2 - t1)
+        return out
 
     call.__wrapped__ = fn
     return call

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from pilosa_tpu import platform
 from pilosa_tpu.config import env_bool
 from pilosa_tpu.core import timeq
+from pilosa_tpu.obs import devprof
 from pilosa_tpu.core.stacked import stacked_set
 from pilosa_tpu.ops import bitmap as B
 from pilosa_tpu.pql.ast import Condition, ROW_OPTIONS
@@ -209,6 +210,17 @@ def _lower_root(ex, idx, call, shard_list: List[int]):
 # ---------------------------------------------------------------------------
 
 
+def _invoke(kind: str, tape: Tuple, n_leaves: int, masked: bool,
+            total_words: int, fn, *args):
+    """Run one compiled program, attributing its device time and
+    analytic FLOP/byte cost to the tape's kernel family when the devprof
+    plane is on. The flag check is the entire disabled-path cost."""
+    if not devprof.ENABLED:
+        return fn(*args)
+    with devprof.kernel_scope(kind, tape, n_leaves, masked, total_words):
+        return fn(*args)
+
+
 def run_count(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:
     """Device count scalar for ``Count(call)`` via one compiled program,
     or None when lowering bails/is disabled."""
@@ -219,10 +231,13 @@ def run_count(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:
     except _Bail:
         return None
     total_words = len(shard_list) * WORDS_PER_SHARD
-    fn = _program("count", tape, len(leaves), mask is not None, total_words)
-    if mask is not None:
-        return fn(*leaves, mask.plane)
-    return fn(*leaves)
+    masked = mask is not None
+    fn = _program("count", tape, len(leaves), masked, total_words)
+    if masked:
+        return _invoke("count", tape, len(leaves), True, total_words,
+                       fn, *leaves, mask.plane)
+    return _invoke("count", tape, len(leaves), False, total_words,
+                   fn, *leaves)
 
 
 def run_plane(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:
@@ -235,8 +250,11 @@ def run_plane(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:
     except _Bail:
         return None
     total_words = len(shard_list) * WORDS_PER_SHARD
-    fn = _program("plane", tape, len(leaves), mask is not None, total_words)
+    masked = mask is not None
+    fn = _program("plane", tape, len(leaves), masked, total_words)
     scratch = scratch_plane(total_words)
-    if mask is not None:
-        return fn(scratch, *leaves, mask.plane)
-    return fn(scratch, *leaves)
+    if masked:
+        return _invoke("plane", tape, len(leaves), True, total_words,
+                       fn, scratch, *leaves, mask.plane)
+    return _invoke("plane", tape, len(leaves), False, total_words,
+                   fn, scratch, *leaves)

@@ -217,13 +217,17 @@ class Auth:
 
     def __init__(self, secret: str, permissions: Permissions,
                  allowed_networks: Optional[List[str]] = None,
-                 oidc=None):
+                 oidc=None, secure_cookies: bool = False):
         self.secret = secret
         self.permissions = permissions
         self.networks = [ipaddress.ip_network(n)
                          for n in (allowed_networks or [])]
         #: optional server.oidc.OIDCAuth — enables the IdP cookie flow
         self.oidc = oidc
+        #: add `Secure` to every session cookie (config
+        #: auth.secure_cookies; off by default so plain-HTTP dev
+        #: deployments keep a working login flow)
+        self.secure_cookies = secure_cookies
 
     def authenticate(self, headers, client_ip: str) -> dict:
         """Returns {"groups": [...], "admin_net": bool}; with OIDC

@@ -141,6 +141,9 @@ _ROUTES = [
     # fan-out merge, SLO burn status, flight-recorder bundles
     ("GET", re.compile(r"^/internal/stats/timeline$"), "get_stats_timeline"),
     ("GET", re.compile(r"^/internal/stats/cluster$"), "get_stats_cluster"),
+    # kernel performance attribution (obs/devprof.py): per-family
+    # MFU/roofline profiles + ingest stage rates
+    ("GET", re.compile(r"^/internal/stats/kernels$"), "get_stats_kernels"),
     ("GET", re.compile(r"^/internal/slo$"), "get_slo"),
     ("GET", re.compile(r"^/internal/debug/bundles$"), "get_debug_bundles"),
     ("GET", re.compile(r"^/internal/debug/bundles/([^/]+)$"),
@@ -171,14 +174,38 @@ _AUTH_EXEMPT = {"get_login", "get_redirect", "get_logout",
                 "get_version", "get_health", "get_userinfo"}
 
 
-def _token_cookies(access: str, refresh: str, expire: bool = False):
+def _token_cookies(access: str, refresh: str, expire: bool = False,
+                   secure: bool = False):
     """Set-Cookie headers for the token pair (reference:
-    authenticate.go:346 SetCookie; names :33-36)."""
+    authenticate.go:346 SetCookie; names :33-36). ``secure`` adds the
+    HTTPS-only attribute (config auth.secure_cookies)."""
     tail = "; Path=/; HttpOnly; SameSite=Strict"
+    if secure:
+        tail += "; Secure"
     if expire:
         tail += "; Expires=Thu, 01 Jan 1970 00:00:00 GMT"
     return [f"molecula-chip={access}{tail}",
             f"refresh-molecula-chip={refresh}{tail}"]
+
+
+_STATE_COOKIE = "molecula-chip-state"
+
+
+def _state_cookie(state: str, secure: bool = False,
+                  expire: bool = False):
+    """Set-Cookie header binding the OIDC anti-CSRF state to this
+    browser: /login sets it, /redirect requires it to match the query
+    state. SameSite=Lax (not Strict) because the IdP→/redirect hop is a
+    cross-site top-level navigation and Strict would withhold the cookie
+    on exactly the request that needs it."""
+    max_age = 0 if expire else 600
+    tail = f"; Path=/redirect; Max-Age={max_age}; HttpOnly; SameSite=Lax"
+    if secure:
+        tail += "; Secure"
+    if expire:
+        state = ""
+        tail += "; Expires=Thu, 01 Jan 1970 00:00:00 GMT"
+    return f"{_STATE_COOKIE}={state}{tail}"
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -262,7 +289,8 @@ class Handler(BaseHTTPRequestHandler):
             # caller's cookies on this response (authenticate.go:174
             # "caller's responsibility to inform the user")
             self._pending_cookies = _token_cookies(
-                info["access"], info["refresh"])
+                info["access"], info["refresh"],
+                secure=self._secure_cookies())
         level, takes_index = ROUTE_LEVELS.get(name, ("admin", False))
         index = match.group(1) if takes_index and match.groups() else None
         self.auth.authorize(ctx, level, index)
@@ -645,6 +673,14 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._send(200, {"enabled": True, **hp.slo.status()})
 
+    def get_stats_kernels(self):
+        # the devprof registry is process-global (not hung off the
+        # health plane), so an in-process LocalCluster's coordinator
+        # reports every node's kernel families from one endpoint
+        from pilosa_tpu.obs import devprof
+
+        self._send(200, devprof.stats_json())
+
     def get_debug_bundles(self):
         hp = self._health_plane()
         if hp is None:
@@ -840,7 +876,8 @@ class Handler(BaseHTTPRequestHandler):
         if info.get("rotated"):
             # re-set cookies, or a one-time-use refresh token is lost
             self._pending_cookies = _token_cookies(
-                info["access"], info["refresh"])
+                info["access"], info["refresh"],
+                secure=self._secure_cookies())
         self._send(200, {"userid": info["userid"],
                          "username": info["username"],
                          "groups": [{"id": g} for g in info["groups"]]})
@@ -1243,8 +1280,17 @@ class Handler(BaseHTTPRequestHandler):
             raise KeyError("OIDC login is not configured")
         return oidc
 
+    def _secure_cookies(self) -> bool:
+        return bool(getattr(self.auth, "secure_cookies", False))
+
     def get_login(self):
-        self._redirect(self._oidc().login_url())
+        oidc = self._oidc()
+        state = oidc.new_state()
+        # bind the state to THIS browser: /redirect requires the cookie
+        # to match the query state (login-CSRF hardening)
+        self._pending_cookies = [
+            _state_cookie(state, secure=self._secure_cookies())]
+        self._redirect(oidc.login_url(state))
 
     def get_redirect(self):
         from urllib.parse import parse_qs, urlparse
@@ -1255,14 +1301,30 @@ class Handler(BaseHTTPRequestHandler):
         if not code:
             raise ValueError("missing code")
         state = (q.get("state") or [""])[0]
-        if not oidc.check_state(state):
-            # unknown/expired state: a code this server's /login did not
-            # initiate must not set session cookies (login CSRF)
+        if self._state_from_cookie() != state or not oidc.check_state(state):
+            # unknown/expired state, or a state this browser did not
+            # initiate (no/mismatched state cookie): a code this
+            # server's /login did not hand THIS user agent must not set
+            # session cookies (login CSRF)
             from pilosa_tpu.server.auth import AuthError
             raise AuthError(403, "invalid OAuth state")
         access, refresh = oidc.exchange_code(code)
-        self._pending_cookies = _token_cookies(access, refresh)
+        secure = self._secure_cookies()
+        self._pending_cookies = _token_cookies(access, refresh,
+                                               secure=secure)
+        self._pending_cookies.append(_state_cookie("", secure=secure,
+                                                   expire=True))
         self._redirect("/")
+
+    def _state_from_cookie(self) -> str:
+        from http.cookies import SimpleCookie
+
+        jar = SimpleCookie()
+        try:
+            jar.load(self.headers.get("Cookie") or "")
+        except Exception:
+            return ""
+        return jar[_STATE_COOKIE].value if _STATE_COOKIE in jar else ""
 
     def get_logout(self):
         from pilosa_tpu.server.auth import _auth_cookies
@@ -1270,7 +1332,8 @@ class Handler(BaseHTTPRequestHandler):
         oidc = self._oidc()
         access, _ = _auth_cookies(self.headers)
         oidc.evict(access)  # drop this session's cached groups
-        self._pending_cookies = _token_cookies("", "", expire=True)
+        self._pending_cookies = _token_cookies(
+            "", "", expire=True, secure=self._secure_cookies())
         self._redirect(oidc.logout_url())
 
     def post_sql_subtree(self):

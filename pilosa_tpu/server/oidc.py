@@ -229,6 +229,11 @@ class OIDCAuth:
             self._groups_cache = {
                 k: v for k, v in self._groups_cache.items()
                 if now - v[1] < self.cache_ttl}
+            # abandoned logins (states never consumed by /redirect) must
+            # not accumulate forever
+            self._states = {
+                k: v for k, v in self._states.items()
+                if now - v < self._state_ttl}
             self._last_clean = now
 
 

@@ -46,6 +46,8 @@ import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
+from pilosa_tpu.obs import devprof
+
 # crc32 over (lsn bytes || payload), payload length, lsn
 _HDR = struct.Struct("<IIQ")
 _LSN = struct.Struct("<Q")
@@ -155,6 +157,9 @@ class WAL:
         # monotonic stamp of the oldest append still awaiting its write
         # barrier (None when clean) — the health plane's WAL-stall read
         self._dirty_since: Optional[float] = None
+        # bytes appended since the last write barrier — the wal_commit
+        # ingest-stage byte count (devprof)
+        self._pending_flush_bytes = 0
         self._open_existing()
 
     # -- open / segments -----------------------------------------------------
@@ -271,6 +276,7 @@ class WAL:
             self._lsn = lsn
             seg = self._segments[-1]
             seg.record_bytes += len(framed)
+            self._pending_flush_bytes += len(framed)
             seg.max_lsn = lsn
             if not self._dirty:
                 self._dirty_since = time.monotonic()
@@ -284,9 +290,21 @@ class WAL:
     def _flush_locked(self) -> None:
         if not self._dirty:
             return
+        if not devprof.ENABLED:
+            self._f.flush()
+            if self.sync != "never":
+                os.fsync(self._f.fileno())
+            self._pending_flush_bytes = 0
+            self._dirty = False
+            self._dirty_since = None
+            return
+        t0 = time.perf_counter()
         self._f.flush()
         if self.sync != "never":
             os.fsync(self._f.fileno())
+        devprof.record_stage("wal_commit", time.perf_counter() - t0,
+                             nbytes=self._pending_flush_bytes)
+        self._pending_flush_bytes = 0
         self._dirty = False
         self._dirty_since = None
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Diff two bench runs and fail on regressions.
+
+Closes the kernel-attribution loop: every ``bench.py`` run can dump its
+per-config metrics + kernel profiles to a JSON-lines profile file
+(``PILOSA_BENCH_PROFILE_OUT=path``), and this comparator diffs two such
+files — or the two most recent driver wrappers (``BENCH_r*.json``, whose
+``tail`` field interleaves the emitted JSON lines with stderr noise) —
+and exits non-zero when any tracked metric regressed by more than the
+threshold (default 15%).
+
+Direction comes from the record's unit: latency units (ms/us/s) regress
+when they go UP; throughput-style units (rows/s, GB/s, x, ...) regress
+when they go DOWN. ``__kernels__`` profile records are carried along for
+context but not gated (MFU on a shared CPU host is too noisy to gate).
+
+Usage:
+    scripts/bench_compare.py OLD NEW [--threshold 0.15]
+    scripts/bench_compare.py --latest        # two newest BENCH_r*.json
+    scripts/bench_compare.py --selftest      # exercises the gate logic
+
+Wired into tier1.sh as a non-fatal report step; CI can run it fatally
+against a pinned baseline profile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: units where a larger value is a regression
+LOWER_IS_BETTER = {"ms", "us", "s", "seconds"}
+
+DEFAULT_THRESHOLD = 0.15
+
+
+def _records_from_lines(lines) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            # last write wins: a re-run config's final number is the one
+            # the driver would have recorded too
+            out[rec["metric"]] = rec
+    return out
+
+
+def load_profile(path: str) -> Dict[str, dict]:
+    """metric -> record from a profile dump (JSON lines) or a driver
+    wrapper ``BENCH_r*.json`` (single object whose "tail" holds the
+    emitted lines mixed with log noise)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        return _records_from_lines(str(doc["tail"]).splitlines())
+    return _records_from_lines(text.splitlines())
+
+
+def _strip_device(metric: str) -> str:
+    """Drop the trailing ``(device)`` tag so a CPU-fallback run still
+    lines up with an accelerator run of the same config."""
+    i = metric.rfind(" (")
+    return metric[:i] if i > 0 and metric.endswith(")") else metric
+
+
+def compare(old: Dict[str, dict], new: Dict[str, dict],
+            threshold: float = DEFAULT_THRESHOLD) -> List[dict]:
+    """Rows for every metric present in both runs; ``regressed`` set
+    when the unit-directed change exceeds the threshold."""
+    old_by = {_strip_device(m): r for m, r in old.items()
+              if m != "__kernels__"}
+    rows: List[dict] = []
+    for metric, rec in sorted(new.items()):
+        if metric == "__kernels__":
+            continue
+        base = old_by.get(_strip_device(metric))
+        if base is None:
+            continue
+        try:
+            ov, nv = float(base["value"]), float(rec["value"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if ov <= 0:
+            continue  # failed/sentinel baselines can't be a ratio
+        unit = str(rec.get("unit", ""))
+        change = (nv - ov) / ov
+        worse = change if unit in LOWER_IS_BETTER else -change
+        rows.append({
+            "metric": _strip_device(metric), "unit": unit,
+            "old": ov, "new": nv,
+            "change_pct": round(change * 100.0, 2),
+            "regressed": worse > threshold,
+        })
+    return rows
+
+
+def latest_wrappers(root: str = ".") -> Tuple[Optional[str], Optional[str]]:
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if len(paths) >= 2:
+        return paths[-2], paths[-1]
+    if len(paths) == 1:
+        return paths[0], paths[0]
+    return None, None
+
+
+def _report(rows: List[dict], threshold: float) -> int:
+    if not rows:
+        print("bench_compare: no common metrics to compare")
+        return 0
+    regressed = [r for r in rows if r["regressed"]]
+    for r in rows:
+        flag = "REGRESSED" if r["regressed"] else "ok"
+        print(f"bench_compare: {flag:>9}  {r['metric']}: "
+              f"{r['old']} -> {r['new']} {r['unit']} "
+              f"({r['change_pct']:+.1f}%)")
+    print(f"bench_compare: {len(rows)} compared, "
+          f"{len(regressed)} regressed (threshold "
+          f"{threshold * 100:.0f}%)")
+    return 1 if regressed else 0
+
+
+def _selftest(threshold: float) -> int:
+    base = {
+        "c13_resident_warm_p50 (cpu)":
+            {"metric": "c13_resident_warm_p50 (cpu)", "value": 10.0,
+             "unit": "ms", "vs_baseline": 5.0},
+        "c1_ingest (cpu)":
+            {"metric": "c1_ingest (cpu)", "value": 500000.0,
+             "unit": "rows/s", "vs_baseline": 0.2},
+    }
+    same = compare(base, base, threshold)
+    assert same and not any(r["regressed"] for r in same), \
+        "identical runs must pass"
+    # synthetic 20% regressions, one in each direction
+    slow = {k: dict(v) for k, v in base.items()}
+    slow["c13_resident_warm_p50 (cpu)"]["value"] = 12.0   # ms up 20%
+    slow["c1_ingest (cpu)"]["value"] = 400000.0           # rows/s down 20%
+    rows = compare(base, slow, threshold)
+    bad = {r["metric"] for r in rows if r["regressed"]}
+    assert bad == {"c13_resident_warm_p50", "c1_ingest"}, bad
+    # a 10% drift stays under the default 15% gate
+    drift = {k: dict(v) for k, v in base.items()}
+    drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0
+    rows = compare(base, drift, threshold)
+    assert not any(r["regressed"] for r in rows), rows
+    print("bench_compare: selftest ok "
+          "(identical passes, 20% regression flagged both directions)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", nargs="?", help="baseline profile/wrapper")
+    ap.add_argument("new", nargs="?", help="candidate profile/wrapper")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="fractional regression gate (default 0.15)")
+    ap.add_argument("--latest", action="store_true",
+                    help="compare the two newest BENCH_r*.json wrappers")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the gate flags a synthetic regression")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest(args.threshold)
+    if args.latest:
+        old_p, new_p = latest_wrappers()
+        if old_p is None:
+            print("bench_compare: no BENCH_r*.json wrappers found")
+            return 0
+    else:
+        if not args.old or not args.new:
+            ap.error("need OLD and NEW (or --latest / --selftest)")
+        old_p, new_p = args.old, args.new
+    print(f"bench_compare: {old_p} -> {new_p}")
+    rows = compare(load_profile(old_p), load_profile(new_p),
+                   args.threshold)
+    return _report(rows, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

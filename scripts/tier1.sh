@@ -115,6 +115,27 @@ echo "== health-plane overhead bench gate (bench.py --configs 15) =="
 # when disabled, and the sampler actually firing when enabled.
 JAX_PLATFORMS=cpu python bench.py --configs 15 || exit $?
 
+echo "== devprof lane (PILOSA_TPU_DEVPROF=1) =="
+# The kernel-attribution plane rides every compiled dispatch in these
+# suites: results must stay bit-identical with profiling on, and the
+# suites assert exactly zero cost-model work when the flag is off.
+PILOSA_TPU_DEVPROF=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_resident.py tests/test_tracing.py \
+    tests/test_health.py tests/test_devprof.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== devprof overhead bench gate (bench.py --configs 16) =="
+# Hard-asserts the ISSUE 11 acceptance bar in-process: bit-identical
+# results with PILOSA_TPU_DEVPROF=1, zero cost-model allocations when
+# disabled, and a profile with MFU/GB/s for every compiled family.
+JAX_PLATFORMS=cpu python bench.py --configs 16 || exit $?
+
+echo "== bench regression report (scripts/bench_compare.py --latest) =="
+# Non-fatal report step: diffs the two most recent BENCH_r*.json driver
+# wrappers when present. CI gates fatally against a pinned baseline.
+python scripts/bench_compare.py --latest \
+    || echo "bench_compare: regressions reported (non-fatal here)"
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

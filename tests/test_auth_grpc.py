@@ -492,18 +492,29 @@ class TestOIDC:
 
     def test_full_login_round_trip(self, oidc_server):
         base, idp, oidc = oidc_server
-        # 1. /login redirects to the IdP's authorize endpoint
+        # 1. /login redirects to the IdP's authorize endpoint and binds
+        #    the anti-CSRF state to this browser via a state cookie
         code_, hdrs, _ = self._get(base + "/login")
         assert code_ == 302 and "/authorize?" in hdrs["Location"]
+        login_cookies = hdrs.get_all("Set-Cookie") or []
+        state_c = [c for c in login_cookies
+                   if c.startswith("molecula-chip-state=")]
+        assert state_c, login_cookies
+        assert "HttpOnly" in state_c[0] and "SameSite=Lax" in state_c[0]
+        state_jar = state_c[0].split(";", 1)[0]
         # 2. IdP authorize redirects back with an auth code
         code_, hdrs, _ = self._get(hdrs["Location"])
         assert code_ == 302 and "code=" in hdrs["Location"]
-        # 3. /redirect exchanges the code and sets token cookies
-        code_, hdrs, _ = self._get(hdrs["Location"])
+        # 3. /redirect exchanges the code and sets token cookies (the
+        #    state cookie must round-trip or the exchange is refused)
+        code_, hdrs, _ = self._get(hdrs["Location"], cookies=state_jar)
         assert code_ == 302
         cookies = hdrs.get_all("Set-Cookie") or []
         pairs = dict(c.split(";", 1)[0].split("=", 1) for c in cookies)
         assert "molecula-chip" in pairs and "refresh-molecula-chip" in pairs
+        # the one-shot state cookie is expired on success
+        assert any(c.startswith("molecula-chip-state=") and
+                   "Expires=Thu, 01 Jan 1970" in c for c in cookies)
         jar = (f"molecula-chip={pairs['molecula-chip']}; "
                f"refresh-molecula-chip={pairs['refresh-molecula-chip']}")
         # 4. a cookie-authenticated request passes authz (READ on t)
@@ -512,6 +523,64 @@ class TestOIDC:
         # no cookies, no bearer -> 401
         code_, _, _ = self._get(base + "/schema")
         assert code_ == 401
+
+    def test_redirect_without_state_cookie_rejected(self, oidc_server):
+        """A /redirect carrying a valid registered state but no bound
+        browser cookie is a CSRF (attacker pastes their own callback
+        URL into the victim's browser) -> 403."""
+        base, idp, oidc = oidc_server
+        _, hdrs, _ = self._get(base + "/login")
+        _, hdrs, _ = self._get(hdrs["Location"])
+        assert "code=" in hdrs["Location"]
+        code_, _, _ = self._get(hdrs["Location"])  # no state cookie
+        assert code_ == 403
+        # wrong state cookie value is equally rejected
+        _, hdrs, _ = self._get(base + "/login")
+        _, hdrs, _ = self._get(hdrs["Location"])
+        code_, _, _ = self._get(hdrs["Location"],
+                                cookies="molecula-chip-state=forged")
+        assert code_ == 403
+
+    def test_unregistered_state_rejected(self, oidc_server):
+        """A state the server never issued fails check_state even when
+        the cookie matches (replay across server restarts)."""
+        base, idp, oidc = oidc_server
+        code_, _, _ = self._get(
+            base + "/redirect?code=x&state=neverissued",
+            cookies="molecula-chip-state=neverissued")
+        assert code_ == 403
+
+    def test_state_cache_evicted(self, oidc_server):
+        """Abandoned /login states must not accumulate: _clean_cache
+        prunes entries older than the state TTL."""
+        base, idp, oidc = oidc_server
+        for _ in range(3):
+            self._get(base + "/login")
+        assert len(oidc._states) >= 3
+        for k in list(oidc._states):
+            oidc._states[k] -= oidc._state_ttl + 1
+        oidc._clean_cache(oidc._clock())
+        assert not oidc._states
+
+    def test_secure_cookie_attribute(self, oidc_server):
+        """Satellite: Secure is absent by default (plain-HTTP dev) and
+        present on every auth cookie when auth.secure-cookies is set."""
+        base, idp, oidc = oidc_server
+        _, hdrs, _ = self._get(base + "/login")
+        assert all("Secure" not in c
+                   for c in hdrs.get_all("Set-Cookie") or [])
+        from pilosa_tpu.server.http import _state_cookie, _token_cookies
+        plain = _token_cookies("a", "r")
+        assert all("Secure" not in c for c in plain)
+        secured = _token_cookies("a", "r", secure=True)
+        assert len(secured) == 2
+        assert all(c.endswith("; Secure") for c in secured)
+        # expiry variants keep the attribute too (logout over https)
+        assert all("Secure" in c
+                   for c in _token_cookies("", "", expire=True,
+                                           secure=True))
+        assert "Secure" in _state_cookie("s1", secure=True)
+        assert "Secure" not in _state_cookie("s1")
 
     def test_group_cache_and_refresh(self, oidc_server):
         base, idp, oidc = oidc_server

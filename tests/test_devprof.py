@@ -1,0 +1,475 @@
+"""Kernel performance attribution plane (ISSUE 11): analytic cost
+model over compiled op tapes, MFU/roofline profiles keyed on
+(family, shape_bucket, mesh_epoch), per-stage ingest throughput, the
+``/internal/stats/kernels`` surface, and the bench regression gate.
+
+The invariants are the acceptance criteria: bit-identical query results
+with the plane on vs off, exactly zero cost-model work while disabled,
+a profile with MFU/GB/s for every compiled family on a warmed cluster,
+and a comparator that passes identical runs while flagging a synthetic
+20% regression.
+"""
+
+import importlib.util
+import json
+import pathlib
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import platform
+from pilosa_tpu.api import API
+from pilosa_tpu.config import Config
+from pilosa_tpu.obs import devprof
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+SHARDS = 2
+
+# three distinct tapes -> three kernel families (two count, one plane)
+QUERIES = [
+    "Count(Row(f=1))",
+    "Count(Intersect(Row(f=1), Row(g=1)))",
+    "Intersect(Row(f=2), Row(g=2))",
+]
+
+
+def _fill(target, index="dk"):
+    target.create_index(index)
+    target.create_field(index, "f")
+    target.create_field(index, "g")
+    rows, cols = [], []
+    for c in range(0, SHARDS * SHARD_WIDTH, SHARD_WIDTH // 16):
+        rows.append((c // 64) % 5)
+        cols.append(c)
+    target.import_bits(index, "f", rows=rows, cols=cols)
+    target.import_bits(index, "g", rows=[r % 3 for r in rows], cols=cols)
+    return index
+
+
+@pytest.fixture
+def profiled():
+    """Plane ON with clean accumulators; restores the ambient state so
+    the suite behaves identically under the PILOSA_TPU_DEVPROF=1 lane."""
+    was = devprof.ENABLED
+    devprof.enable()
+    devprof.reset()
+    yield
+    devprof.reset()
+    devprof.enable() if was else devprof.disable()
+
+
+@pytest.fixture
+def unprofiled():
+    was = devprof.ENABLED
+    devprof.disable()
+    devprof.reset()
+    yield
+    devprof.enable() if was else devprof.disable()
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost model
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_count_tape_cost(self):
+        # 1 op + popcount pass = 2 word passes * 32 lanes * 1024 words;
+        # 2 leaf planes read * 4B * 1024 + 8B count scalar
+        assert devprof.tape_cost("count", (("and", 0, 1),), 2, False,
+                                 1024) == (65536.0, 8200.0)
+
+    def test_plane_tape_cost_counts_scratch_write(self):
+        flops, hbm = devprof.tape_cost(
+            "plane", (("or", 0, 1), ("and", 2, 3)), 3, False, 512)
+        assert flops == 32.0 * 2 * 512
+        assert hbm == 4.0 * (3 + 1) * 512  # +1 scratch write, no scalar
+
+    def test_mask_adds_one_pass_and_one_plane(self):
+        flops, hbm = devprof.tape_cost("count", (("and", 0, 1),), 2,
+                                       True, 1024)
+        assert flops == 32.0 * 3 * 1024   # op + mask-AND + popcount
+        assert hbm == 4.0 * 3 * 1024 + 8.0
+
+    def test_cost_evals_counter_increments(self):
+        before = devprof.cost_evals()
+        devprof.tape_cost("count", (("or", 0, 1),), 2, False, 64)
+        assert devprof.cost_evals() == before + 1
+
+    def test_family_name_structure(self):
+        fam = devprof.family_name("count", (("and", 0, 1),), 2, False)
+        assert fam.startswith("count/2l/and1#") and len(fam) > 14
+        # op mix is sorted and counted; the mask is tagged
+        fam2 = devprof.family_name(
+            "plane", (("or", 0, 1), ("and", 2, 3), ("or", 4, 5)), 3, True)
+        assert fam2.startswith("plane/3l/and1+or2/m#")
+        # distinct tape structure -> distinct digest
+        a = devprof.family_name("count", (("and", 0, 1),), 2, False)
+        b = devprof.family_name("count", (("and", 1, 0),), 2, False)
+        assert a != b
+
+    def test_shape_bucket_next_pow2(self):
+        assert devprof.shape_bucket(1) == 1
+        assert devprof.shape_bucket(3) == 4
+        assert devprof.shape_bucket(1024) == 1024
+        assert devprof.shape_bucket(1025) == 2048
+
+
+# ---------------------------------------------------------------------------
+# KernelProfileRegistry + IngestAccounting
+# ---------------------------------------------------------------------------
+
+
+class TestKernelProfileRegistry:
+    def _reg(self):
+        return devprof.KernelProfileRegistry()
+
+    def test_accumulate_and_roofline_snapshot(self):
+        reg = self._reg()
+        ent = reg.entry_for("count", (("and", 0, 1),), 2, False, 1024, 0)
+        reg.record(ent, 0.001, 0.002)
+        reg.record(ent, 0.001, 0.002)
+        (row,) = reg.snapshot()
+        assert row["dispatches"] == 2
+        assert row["device_seconds"] == pytest.approx(0.006)
+        assert row["flops"] == pytest.approx(2 * 65536.0)
+        assert row["hbm_bytes"] == pytest.approx(2 * 8200.0)
+        assert row["mfu_pct"] > 0 and row["achieved_gbps"] > 0
+        assert row["us_per_dispatch"] == pytest.approx(3000.0)
+        # bitmap tapes sit below any ridge point: memory-bound
+        assert row["intensity_flops_per_byte"] == pytest.approx(
+            65536.0 / 8200.0, rel=1e-3)
+        assert row["roofline_bound"] == "memory"
+
+    def test_same_family_different_bucket_split(self):
+        reg = self._reg()
+        reg.record(reg.entry_for("count", (("and", 0, 1),), 2, False,
+                                 1024, 0), 0.001, 0.0)
+        reg.record(reg.entry_for("count", (("and", 0, 1),), 2, False,
+                                 4096, 0), 0.002, 0.0)
+        rows = reg.snapshot()
+        assert len(rows) == 2
+        assert {r["shape_bucket"] for r in rows} == {1024, 4096}
+        # sorted by device time, biggest first
+        assert rows[0]["device_seconds"] >= rows[1]["device_seconds"]
+
+    def test_mesh_epoch_keys_profiles_apart(self):
+        reg = self._reg()
+        reg.record(reg.entry_for("count", (("and", 0, 1),), 2, False,
+                                 1024, 0), 0.001, 0.0)
+        reg.record(reg.entry_for("count", (("and", 0, 1),), 2, False,
+                                 1024, 1), 0.001, 0.0)
+        assert reg.profile_count() == 2
+
+    def test_call_cache_reuses_allocations(self):
+        reg = self._reg()
+        args = ("count", (("and", 0, 1),), 2, False, 1024, 0)
+        e1 = reg.entry_for(*args)
+        allocs = reg.allocations
+        assert allocs == 2  # one profile + one call-cache entry
+        assert reg.entry_for(*args) is e1
+        assert reg.allocations == allocs
+
+    def test_unattributed_dispatch_lands_in_other(self):
+        reg = self._reg()
+        reg.record(None, 0.001, 0.002)
+        assert reg.other_dispatches == 1
+        assert reg.other_device_s == pytest.approx(0.003)
+        assert reg.snapshot() == []  # "other" is not a kernel profile
+
+    def test_h2d_accounting(self):
+        reg = self._reg()
+        reg.record_h2d(1 << 20, 0.001)
+        h = reg.h2d_json()
+        assert h["copies"] == 1 and h["bytes"] == 1 << 20
+        assert h["achieved_gbps"] == pytest.approx(
+            (1 << 20) / 0.001 / 1e9, rel=1e-3)
+
+    def test_snapshot_limit(self):
+        reg = self._reg()
+        for i in range(5):
+            reg.record(reg.entry_for("count", (("and", 0, 1),), 2, False,
+                                     1 << (6 + i), 0), 0.001 * (i + 1), 0.0)
+        assert len(reg.snapshot(limit=3)) == 3
+
+    def test_ingest_accounting_rates(self):
+        acc = devprof.IngestAccounting()
+        acc.record("parse", 0.5, rows=1000)
+        acc.record("parse", 0.5, rows=1000)
+        acc.record("wal_commit", 0.25, nbytes=1 << 20)
+        snap = acc.snapshot()
+        assert snap["parse"]["rows"] == 2000
+        assert snap["parse"]["batches"] == 2
+        assert snap["parse"]["rows_per_s"] == pytest.approx(2000.0)
+        assert snap["wal_commit"]["bytes_per_s"] == pytest.approx(
+            (1 << 20) / 0.25)
+
+
+# ---------------------------------------------------------------------------
+# Gating: zero work disabled, attribution enabled, identical results
+# ---------------------------------------------------------------------------
+
+
+class TestGating:
+    def test_disabled_means_zero_cost_model_work(self, unprofiled):
+        api = API()
+        _fill(api)
+        evals = devprof.cost_evals()
+        allocs = devprof.KERNELS.allocations
+        for q in QUERIES:
+            api.query("dk", q)
+        assert devprof.cost_evals() == evals
+        assert devprof.KERNELS.allocations == allocs
+        assert devprof.KERNELS.profile_count() == 0
+        assert platform._DISPATCH_HOOK is None
+        assert platform._H2D_HOOK is None
+        assert devprof.stats_json() == {"enabled": False}
+
+    def test_enabled_attributes_every_compiled_family(self, profiled):
+        api = API()
+        _fill(api)
+        for q in QUERIES:
+            api.query("dk", q)
+        rows = devprof.KERNELS.snapshot()
+        # three distinct tapes -> three families, all with device time
+        assert len(rows) >= 3
+        kinds = {r["family"].split("/")[0] for r in rows}
+        assert kinds == {"count", "plane"}
+        for r in rows:
+            assert r["dispatches"] > 0
+            assert r["device_seconds"] > 0
+            assert r["mfu_pct"] > 0
+            assert r["achieved_gbps"] > 0
+        s = devprof.stats_json()
+        assert s["enabled"] and s["backend"]
+        assert s["peak_tflops"] > 0 and s["peak_gbps"] > 0
+        assert s["cost_evals"] >= 3
+
+    def test_results_bit_identical_on_vs_off(self, unprofiled):
+        api = API()
+        _fill(api)
+        off = [api.query_json("dk", q) for q in QUERIES]
+        devprof.enable()
+        try:
+            on = [api.query_json("dk", q) for q in QUERIES]
+        finally:
+            devprof.disable()
+        assert json.dumps(on, sort_keys=True) \
+            == json.dumps(off, sort_keys=True)
+
+    def test_peak_override_env(self, profiled, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_DEVPROF_PEAK_TFLOPS", "2.0")
+        monkeypatch.setenv("PILOSA_TPU_DEVPROF_PEAK_GBPS", "50.0")
+        assert devprof.peaks() == (2.0, 50.0)
+
+
+# ---------------------------------------------------------------------------
+# Hook attribution details
+# ---------------------------------------------------------------------------
+
+
+class TestHooks:
+    def test_h2d_attributed_to_ingest_only_in_scope(self, profiled):
+        host = np.zeros(1024, dtype=np.uint32)
+        platform.h2d_copy(host)  # outside any ingest scope
+        assert devprof.KERNELS.h2d_copies == 1
+        assert "h2d_copy" not in devprof.INGEST.snapshot()
+        with devprof.ingest_scope():
+            platform.h2d_copy(host)
+        assert devprof.KERNELS.h2d_copies == 2
+        stage = devprof.INGEST.snapshot()["h2d_copy"]
+        assert stage["bytes"] == host.nbytes
+
+    def test_kernel_scope_nests_and_restores(self, profiled):
+        outer = ("count", (("and", 0, 1),), 2, False, 64)
+        inner = ("plane", (("or", 0, 1),), 2, False, 64)
+        with devprof.kernel_scope(*outer):
+            ent_outer = devprof._TLS.kernel
+            with devprof.kernel_scope(*inner):
+                assert devprof._TLS.kernel is not ent_outer
+            assert devprof._TLS.kernel is ent_outer
+        assert getattr(devprof._TLS, "kernel", None) is None
+
+
+# ---------------------------------------------------------------------------
+# Ingest stage accounting through the real pipeline
+# ---------------------------------------------------------------------------
+
+
+class TestIngestStages:
+    CSV = "id,city__S,pop__I\n" + "\n".join(
+        f"{i},c{i % 7},{1000 + i}" for i in range(300))
+
+    def test_columnar_ingest_populates_stages(self, profiled, tmp_path):
+        from pilosa_tpu.ingest.ingest import Ingester
+        from pilosa_tpu.ingest.source import CSVSource
+
+        api = API(str(tmp_path))  # durable: WAL commits are real
+        src = CSVSource(self.CSV, inline=True)
+        n = Ingester(api, "cities", src).run()
+        assert n == 300
+        snap = devprof.INGEST.snapshot()
+        assert snap["parse"]["rows"] == 300
+        assert snap["parse"]["rows_per_s"] > 0
+        # city__S is keyed -> bulk translation is timed
+        assert snap["key_translate"]["rows"] > 0
+        assert snap["fragment_advance"]["rows"] > 0
+        assert snap["wal_commit"]["bytes"] > 0
+        assert snap["wal_commit"]["bytes_per_s"] > 0
+
+    def test_batch_path_records_stages_too(self, profiled):
+        from pilosa_tpu.ingest.datagen import scenario
+        from pilosa_tpu.ingest.ingest import Ingester
+
+        # record-stream sources (datagen, Kafka-style) ride the Batch
+        # path: no whole-file parse stage, but fragment advance is timed
+        api = API()
+        Ingester(api, "cust", scenario("customer", rows=100)).run()
+        snap = devprof.INGEST.snapshot()
+        assert snap["fragment_advance"]["rows"] > 0
+
+    def test_disabled_ingest_records_nothing(self, unprofiled, tmp_path):
+        from pilosa_tpu.ingest.ingest import Ingester
+        from pilosa_tpu.ingest.source import CSVSource
+
+        api = API(str(tmp_path))
+        Ingester(api, "cities", CSVSource(self.CSV, inline=True)).run()
+        assert devprof.INGEST.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Serving surfaces: /internal/stats/kernels + the health-plane probe
+# ---------------------------------------------------------------------------
+
+
+class TestServing:
+    def test_stats_kernels_on_warmed_cluster(self, profiled):
+        from pilosa_tpu.cluster import LocalCluster
+
+        with LocalCluster(3) as c:
+            _fill(c.coordinator)
+            for _ in range(2):  # warm: second pass hits compiled programs
+                for q in QUERIES:
+                    c.coordinator.query("dk", q)
+            uri = c.coordinator.node.uri
+            with urllib.request.urlopen(
+                    uri + "/internal/stats/kernels") as r:
+                payload = json.loads(r.read())
+        assert payload["enabled"] is True
+        assert payload["ridge_flops_per_byte"] > 0
+        fams = {k["family"] for k in payload["kernels"]}
+        assert len(fams) >= len(QUERIES)
+        for k in payload["kernels"]:
+            assert k["mfu_pct"] > 0
+            assert k["achieved_gbps"] > 0
+            assert k["roofline_bound"] in ("memory", "compute")
+
+    def test_stats_kernels_disabled_payload(self, unprofiled):
+        from pilosa_tpu.server.http import serve
+
+        api = API()
+        srv, _ = serve(api, port=0, background=True)
+        try:
+            host, port = srv.server_address[:2]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/internal/stats/kernels") as r:
+                assert json.loads(r.read()) == {"enabled": False}
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_timeline_probe_rides_health_samples(self, profiled):
+        api = API()
+        _fill(api)
+        api.enable_health(config=Config())
+        for q in QUERIES:
+            api.query("dk", q)
+        samp = api.health.timeline.sample()
+        probe = samp["probes"]["kernels"]
+        assert probe["enabled"] is True
+        assert probe["kernels"], probe
+        assert len(probe["kernels"]) <= 8  # bundles are size-bounded
+        api.disable_health()
+
+    def test_timeline_probe_disabled(self, unprofiled):
+        assert devprof.timeline_probe() == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the regression gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    path = pathlib.Path(__file__).resolve().parents[1] / "scripts" \
+        / "bench_compare.py"
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchCompare:
+    @pytest.fixture(scope="class")
+    def bc(self):
+        return _bench_compare()
+
+    def _base(self):
+        return {
+            "q_p50 (cpu)": {"metric": "q_p50 (cpu)", "value": 10.0,
+                            "unit": "ms"},
+            "ingest (cpu)": {"metric": "ingest (cpu)", "value": 1e6,
+                             "unit": "rows/s"},
+        }
+
+    def test_identical_runs_pass(self, bc):
+        rows = bc.compare(self._base(), self._base())
+        assert rows and not any(r["regressed"] for r in rows)
+
+    def test_twenty_pct_regression_flagged_both_directions(self, bc):
+        worse = {k: dict(v) for k, v in self._base().items()}
+        worse["q_p50 (cpu)"]["value"] = 12.0    # latency up 20%
+        worse["ingest (cpu)"]["value"] = 8e5    # throughput down 20%
+        rows = bc.compare(self._base(), worse)
+        assert {r["metric"] for r in rows if r["regressed"]} \
+            == {"q_p50", "ingest"}
+
+    def test_improvements_and_small_drift_pass(self, bc):
+        better = {k: dict(v) for k, v in self._base().items()}
+        better["q_p50 (cpu)"]["value"] = 5.0    # latency halved: good
+        better["ingest (cpu)"]["value"] = 1.1e6  # +10%: good
+        rows = bc.compare(self._base(), better)
+        assert not any(r["regressed"] for r in rows)
+
+    def test_selftest_passes(self, bc):
+        assert bc._selftest(0.15) == 0
+
+    def test_load_profile_json_lines_and_wrapper(self, bc, tmp_path):
+        lines = tmp_path / "profile.json"
+        lines.write_text(
+            '{"metric": "m1", "value": 1.0, "unit": "ms"}\n'
+            'xla warning noise\n'
+            '{"metric": "__kernels__", "profile": {}}\n')
+        recs = bc.load_profile(str(lines))
+        assert recs["m1"]["value"] == 1.0 and "__kernels__" in recs
+        wrapper = tmp_path / "BENCH_r99.json"
+        wrapper.write_text(json.dumps({
+            "n": 99, "cmd": "python bench.py", "rc": 0,
+            "tail": 'Platform noise\n'
+                    '{"metric": "m1", "value": 2.0, "unit": "ms"}\n'
+                    'DOTS_PASSED=3\n'}))
+        recs = bc.load_profile(str(wrapper))
+        assert recs["m1"]["value"] == 2.0
+
+    def test_cli_exit_codes(self, bc, tmp_path):
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text('{"metric": "m (cpu)", "value": 10.0, '
+                       '"unit": "ms"}\n')
+        new.write_text('{"metric": "m (cpu)", "value": 10.5, '
+                       '"unit": "ms"}\n')
+        assert bc.main([str(old), str(new)]) == 0
+        new.write_text('{"metric": "m (cpu)", "value": 20.0, '
+                       '"unit": "ms"}\n')
+        assert bc.main([str(old), str(new)]) == 1

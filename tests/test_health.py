@@ -155,6 +155,47 @@ class TestTimelineSampler:
         assert estimate_quantile([10.0, 20.0], [0, 0, 3], 0.99) == 20.0
         assert estimate_quantile([10.0], [0, 0], 0.5) == 0.0
 
+    def test_estimate_quantile_empty_delta_window(self):
+        # an interval where no histogram observations landed produces an
+        # all-zero delta; any quantile over it must be 0.0, not a crash
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert estimate_quantile([10.0, 20.0], [0, 0, 0], q) == 0.0
+        assert estimate_quantile([], [], 0.5) == 0.0
+        # negative deltas (counter reset mid-window) also sum to <= 0
+        assert estimate_quantile([10.0], [-2, 0], 0.5) == 0.0
+
+    def test_estimate_quantile_single_populated_bucket(self):
+        bounds = [10.0, 20.0, 30.0]
+        # every quantile interpolates within the one live bucket
+        assert estimate_quantile(bounds, [0, 10, 0, 0], 0.1) \
+            == pytest.approx(11.0)
+        assert estimate_quantile(bounds, [0, 10, 0, 0], 1.0) \
+            == pytest.approx(20.0)
+        # first bucket interpolates from an implicit 0.0 lower edge
+        assert estimate_quantile(bounds, [4, 0, 0, 0], 0.5) \
+            == pytest.approx(5.0)
+
+    def test_estimate_quantile_all_counts_in_overflow(self):
+        # nothing sane can be interpolated past +Inf: clamp to bounds[-1]
+        bounds = [10.0, 20.0, 30.0]
+        for q in (0.01, 0.5, 1.0):
+            assert estimate_quantile(bounds, [0, 0, 0, 7], q) == 30.0
+        # degenerate: overflow counts but no finite bounds at all
+        assert estimate_quantile([], [5], 0.5) == 0.0
+
+    def test_estimate_quantile_exact_bucket_boundary(self):
+        # rank landing exactly on a bucket's cumulative edge stays inside
+        # that bucket and interpolates to its upper bound, not past it
+        bounds = [10.0, 20.0]
+        counts = [2, 2, 0]  # cum edges at rank 2 and 4
+        assert estimate_quantile(bounds, counts, 0.5) \
+            == pytest.approx(10.0)  # rank=2 == first bucket's cum edge
+        assert estimate_quantile(bounds, counts, 1.0) \
+            == pytest.approx(20.0)
+        # q=0 takes the first populated bucket's lower edge
+        assert estimate_quantile(bounds, counts, 0.0) \
+            == pytest.approx(0.0)
+
     def test_window_filters_by_clock(self):
         clock = ManualClock()
         tl = TimelineSampler(registry=M.MetricsRegistry(), clock=clock)
