@@ -15,7 +15,7 @@ from pilosa_tpu.cluster.client import (  # noqa: F401
     InternalClient, LegCancelled, NodeDownError, RemoteError,
 )
 from pilosa_tpu.cluster.disco import (  # noqa: F401
-    DisCo, InMemDisCo, SingleNodeDisCo, StaticDisCo,
+    DisCo, GossipDisCo, InMemDisCo, SingleNodeDisCo, StaticDisCo,
 )
 from pilosa_tpu.cluster.executor import ClusterExecutor  # noqa: F401
 from pilosa_tpu.cluster.harness import LocalCluster  # noqa: F401

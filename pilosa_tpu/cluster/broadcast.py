@@ -10,6 +10,7 @@ for single-node use.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional
 
 # Message types (reference: broadcast.go:55-77 messageType* values).
@@ -17,6 +18,7 @@ MSG_CREATE_INDEX = "create-index"
 MSG_DELETE_INDEX = "delete-index"
 MSG_CREATE_FIELD = "create-field"
 MSG_DELETE_FIELD = "delete-field"
+MSG_AVAILABLE_SHARDS = "available-shards"
 MSG_CREATE_VIEW = "create-view"
 MSG_DELETE_VIEW = "delete-view"
 MSG_UPDATE_FIELD = "update-field"
@@ -86,6 +88,60 @@ class HTTPBroadcaster(Broadcaster):
 
     def send_to(self, msg: Dict, node) -> None:
         self._client.send_message(node, msg)
+
+
+class GossipBroadcaster(Broadcaster):
+    """Partition-tolerant wrapper over another broadcaster: idempotent
+    control messages ALSO ride the gossip plane as origin-sequenced
+    ``("c", n)`` entries, so a peer the direct push cannot reach right
+    now (partitioned, restarting, coordinator down) still converges via
+    anti-entropy — no replica is stranded by one failed broadcast.
+
+    Only the idempotent whitelist gets the relaxed contract: schema
+    creates/deletes re-apply as ensure/ignore-missing and available-
+    shards is a set union, so double delivery (direct push + gossip
+    apply) is harmless and ``send_sync`` may tolerate unreachable
+    peers. Everything else (transactions!) keeps the inner
+    broadcaster's strict all-peers-ack semantics unchanged."""
+
+    GOSSIP_TYPES = frozenset({
+        MSG_CREATE_INDEX, MSG_DELETE_INDEX, MSG_CREATE_FIELD,
+        MSG_DELETE_FIELD, MSG_AVAILABLE_SHARDS,
+    })
+
+    def __init__(self, inner: Broadcaster, agent):
+        self.inner = inner
+        self.agent = agent
+        self._lock = threading.Lock()
+        self._n = 0  # per-origin message counter: each message its own key
+
+    def _record(self, msg: Dict) -> bool:
+        if msg.get("type") not in self.GOSSIP_TYPES:
+            return False
+        from pilosa_tpu.gossip.state import KIND_CONTROL
+
+        with self._lock:
+            self._n += 1
+            n = self._n
+        self.agent.state.bump_local((KIND_CONTROL, n), dict(msg))
+        return True
+
+    def send_sync(self, msg: Dict) -> None:
+        recorded = self._record(msg)
+        try:
+            self.inner.send_sync(msg)
+        except RuntimeError:
+            if not recorded:
+                raise
+            # unreachable peers pick the entry up via anti-entropy /
+            # piggyback; reachable ones already applied the direct push
+
+    def send_async(self, msg: Dict) -> None:
+        self._record(msg)
+        self.inner.send_async(msg)
+
+    def send_to(self, msg: Dict, node) -> None:
+        self.inner.send_to(msg, node)
 
 
 def apply_message(api, msg: Dict) -> None:

@@ -122,6 +122,11 @@ class InternalClient:
         # send, keyed on the target node id (duck-typed: anything with
         # on_request(node_id, token=, op=)).
         self.fault_plan = fault_plan
+        # The node id this client sends AS (ClusterNode sets it). Only
+        # when set do FaultPlan partition rules see a source — so
+        # anonymous/external clients and custom fault doubles that don't
+        # accept source= keep working unchanged.
+        self.self_id: Optional[str] = None
         # Optional gossip.GossipAgent: when set, query/import/broadcast
         # requests carry a piggybacked gossip envelope and responses'
         # envelopes are applied — dissemination at RPC speed with zero
@@ -170,7 +175,12 @@ class InternalClient:
                     headers["x-trace-attempt"] = str(attempt)
             try:
                 if self.fault_plan is not None and node_id is not None:
-                    self.fault_plan.on_request(node_id, token=token, op=op)
+                    if self.self_id is not None:
+                        self.fault_plan.on_request(node_id, token=token,
+                                                   op=op, source=self.self_id)
+                    else:
+                        self.fault_plan.on_request(node_id, token=token,
+                                                   op=op)
                 status, data = self._send_once(method, url, body, headers,
                                                timeout, node_id, op)
                 if status >= 400:
@@ -444,6 +454,17 @@ class InternalClient:
         out = self._post(node, "/internal/cluster/message",
                          self._piggyback(node, msg), op="broadcast")
         self._apply_gossip(out)
+
+    def membership_ping(self, node, payload: dict, token=None) -> dict:
+        """SWIM probe / ping-req relay (gossip/membership.py). Tagged
+        op="ping" so FaultPlan partition rules can sever only the probe
+        path; carries a piggybacked gossip envelope, so the very ping
+        that discovers a suspicion also delivers the refutation."""
+        out = self._post(node, "/internal/membership/ping",
+                         self._piggyback(node, payload),
+                         token=token, op="ping")
+        self._apply_gossip(out)
+        return out
 
     def gossip_exchange(self, node, payload: dict) -> dict:
         """Anti-entropy push/pull: POST our envelope, the peer replies

@@ -318,6 +318,51 @@ class LeaseDisCo(DisCo):
             self._forced_down.pop(node_id, None)
 
 
+class GossipDisCo(DisCo):
+    """SWIM-backed liveness over a seed DisCo's discovery.
+
+    The seed (LeaseDisCo / StaticDisCo / InMemDisCo) keeps answering
+    ``nodes()`` — who CAN be in the cluster — while the gossip-native
+    membership protocol (gossip/membership.py) decides who IS live:
+    ``live_ids()`` excludes only members the protocol has CONFIRMED
+    down (suspects stay routed; hedging and breakers absorb the true
+    failures, and a false suspicion is refuted before the timeout).
+    Transport-level hints from the executor become protocol evidence —
+    a connection failure publishes a refutable suspicion instead of
+    unilaterally forcing the node out, so one coordinator's flaky link
+    can no longer evict a healthy peer cluster-wide.
+    """
+
+    def __init__(self, seed: DisCo, membership):
+        self.seed = seed
+        self.membership = membership
+
+    def nodes(self) -> List[Node]:
+        return self.seed.nodes()
+
+    def live_ids(self) -> List[str]:
+        return self.membership.live_ids([n.id for n in self.seed.nodes()])
+
+    def is_live(self, node_id: str) -> bool:
+        return node_id in self.live_ids()
+
+    def mark_down(self, node_id: str) -> None:
+        self.membership.evidence_down(node_id)
+
+    def mark_up(self, node_id: str) -> None:
+        self.membership.evidence_alive(node_id)
+
+    # harness pause()/unpause() use the short spelling (InMemDisCo's);
+    # ClusterNode._mark_down also prefers a "down" attr when present
+    down = mark_down
+    up = mark_up
+
+    def register(self, node: Node) -> None:
+        reg = getattr(self.seed, "register", None)
+        if reg is not None:
+            reg(node)
+
+
 class SingleNodeDisCo(DisCo):
     """The degenerate one-node cluster (default for embedded use)."""
 

@@ -86,6 +86,25 @@ class LocalCluster:
         ``clock=``). Returns the planes in node order."""
         return [node.enable_health(**kw) for node in self.nodes]
 
+    def enable_membership(self, **kw) -> list:
+        """Enable SWIM membership on every node (ClusterNode.enable_
+        membership kwargs pass through; gossip auto-enables). Tests
+        usually share one ManualClock via ``clock=`` and drive protocol
+        rounds with run_gossip_rounds (the tick rides the round hooks)
+        or run_membership_ticks. Returns the Membership objects."""
+        return [node.enable_membership(**kw) for node in self.nodes]
+
+    def run_membership_ticks(self, rounds: int = 1) -> list:
+        """Drive ``rounds`` protocol ticks on every node WITHOUT a full
+        anti-entropy exchange (probe/suspect/confirm only — use
+        run_gossip_rounds for ticks + dissemination). Returns the last
+        round's tick results in node order."""
+        out = []
+        for _ in range(rounds):
+            out = [node.membership.tick() for node in self.nodes
+                   if node.membership is not None]
+        return out
+
     def run_gossip_rounds(self, rounds: int = 1) -> int:
         """Drive ``rounds`` synchronous anti-entropy rounds across every
         node (round-robin, node order) — the deterministic stand-in for
@@ -104,6 +123,14 @@ class LocalCluster:
         rather than hangs."""
         self._servers[i].shutdown()
         self._servers[i].server_close()
+        # closing the listener refuses NEW connections, but peers'
+        # keep-alive pools still hold live sockets the paused server's
+        # handler threads keep serving — evict them so the node is
+        # actually unreachable (membership probes must see it die)
+        for node in self.nodes:
+            evict = getattr(node.client, "evict_node", None)
+            if evict is not None:
+                evict(f"node{i}")
         if self.disco is not None:
             self.disco.down(f"node{i}")
         else:  # per-node disco (LeaseDisCo): stop heartbeating

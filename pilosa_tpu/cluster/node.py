@@ -41,7 +41,7 @@ from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.pql.result import result_to_json, result_to_wire
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
-MSG_AVAILABLE_SHARDS = "available-shards"
+MSG_AVAILABLE_SHARDS = B.MSG_AVAILABLE_SHARDS
 
 
 class ClusterNode:
@@ -55,6 +55,11 @@ class ClusterNode:
             self.disco.register(self.node)
         self.replica_n = replica_n
         self.client = client or InternalClient()
+        # declare who this client sends AS, so FaultPlan partition rules
+        # can match (source, target) pairs; don't touch clients that
+        # don't carry the attribute (duck-typed test doubles)
+        if getattr(self.client, "self_id", "") is None:
+            self.client.self_id = node_id
         self.broadcaster = B.HTTPBroadcaster(
             self.client, self.disco.nodes, node_id)
         self._remote_exec = Executor(self.api.holder, remote=True)
@@ -508,6 +513,7 @@ class ClusterNode:
         return agent
 
     def disable_gossip(self) -> None:
+        self.disable_membership()  # membership rides the agent
         agent, self.executor.gossip = self.executor.gossip, None
         self.client.gossip = None
         listener = getattr(self, "_gossip_listener", None)
@@ -554,6 +560,144 @@ class ClusterNode:
 
             res.registry.count(M.METRIC_GOSSIP_BREAKER_PREWARMS,
                                node=target)
+
+    # -- gossip-native membership (gossip/membership.py) -------------------
+
+    @property
+    def membership(self):
+        return getattr(self, "_membership", None)
+
+    def enable_membership(self, config=None, **overrides):
+        """Attach the SWIM membership protocol and make it the source of
+        truth for liveness: ``self.disco`` becomes a GossipDisCo over the
+        previous (seed) DisCo, the broadcaster gains gossip-backed
+        schema/shard dissemination, translate replication rides the
+        gossip plane as a second channel, and the membership tick + the
+        translator's outbox flush run on every anti-entropy round.
+        Requires gossip (auto-enabled when absent)."""
+        from pilosa_tpu.cluster.disco import GossipDisCo
+        from pilosa_tpu.gossip import (
+            KIND_CONTROL, KIND_TRANSLATE, Membership,
+        )
+
+        self.disable_membership()
+        agent = self.executor.gossip
+        if agent is None:
+            agent = self.enable_gossip(config)
+        peers_fn = lambda: [n for n in self.disco.nodes()
+                            if n.id != self.node.id]
+        m = Membership.from_config(self.node.id, agent, self.client,
+                                   peers_fn, config, **overrides)
+        self._membership = m
+        self._seed_disco = self.disco
+        self.disco = GossipDisCo(self._seed_disco, m)
+        self.broadcaster = B.GossipBroadcaster(self.broadcaster, agent)
+        agent.state.add_kind_listener(KIND_CONTROL,
+                                      self._apply_control_entry)
+        agent.state.add_kind_listener(KIND_TRANSLATE,
+                                      self._apply_translate_entry)
+        self.executor.translator.gossip_publish = (
+            self._publish_translate_entries)
+        agent.round_hooks.append(m.tick)
+        agent.round_hooks.append(self.executor.translator.flush_outbox)
+        return m
+
+    def disable_membership(self) -> None:
+        m = getattr(self, "_membership", None)
+        if m is None:
+            return
+        from pilosa_tpu.gossip import (
+            KIND_CONTROL, KIND_MEMBER, KIND_TRANSLATE,
+        )
+
+        agent = m.agent
+        agent.state.remove_kind_listener(KIND_MEMBER, m._on_member_entry)
+        agent.state.remove_kind_listener(KIND_CONTROL,
+                                         self._apply_control_entry)
+        agent.state.remove_kind_listener(KIND_TRANSLATE,
+                                         self._apply_translate_entry)
+        for hook in (m.tick, self.executor.translator.flush_outbox):
+            try:
+                agent.round_hooks.remove(hook)
+            except ValueError:
+                pass
+        self.executor.translator.gossip_publish = None
+        if isinstance(self.broadcaster, B.GossipBroadcaster):
+            self.broadcaster = self.broadcaster.inner
+        seed = getattr(self, "_seed_disco", None)
+        if seed is not None:
+            self.disco = seed
+            self._seed_disco = None
+        self._membership = None
+
+    def _apply_control_entry(self, origin: str, key, value) -> None:
+        """A peer's gossiped control message (GossipBroadcaster whitelist)
+        reached us via anti-entropy — apply it exactly like a direct
+        broadcast; every whitelisted type is idempotent, so the direct
+        push arriving too is harmless."""
+        if not isinstance(value, dict):
+            return
+        try:
+            self.receive_message(dict(value))
+        except Exception:
+            pass  # best-effort second channel; the direct push governs
+
+    def _publish_translate_entries(self, index: str, field, entries,
+                                   batch_no: int) -> None:
+        agent = self.executor.gossip
+        if agent is not None:
+            from pilosa_tpu.gossip import KIND_TRANSLATE
+
+            agent.state.bump_local(
+                (KIND_TRANSLATE, index, field or "", int(batch_no)),
+                entries)
+
+    def _apply_translate_entry(self, origin: str, key, value) -> None:
+        """A peer's gossiped translate batch: apply the (key, id) entries
+        to the local store. apply_entries is last-write-wins on identical
+        primary-allocated ids, so re-application (direct push + gossip)
+        is a no-op."""
+        if not isinstance(value, list):
+            return
+        index, field = key[1], (key[2] or None)
+        try:
+            self.executor.translator.apply_replicated(
+                index, field, [(k, int(i)) for k, i in value])
+        except KeyError:
+            pass  # index/field not created here yet: the schema control
+            # entry (same origin, earlier seq) normally precedes this
+            # one; a race just means the direct push delivers later
+
+    def membership_ping(self, body: dict) -> dict:
+        """Serve POST /internal/membership/ping: a direct probe ("am I
+        up?") or a ping-req relay (``target`` set: probe the target over
+        OUR link and report — the indirect path that distinguishes a
+        dead node from our own dead link to it)."""
+        from pilosa_tpu.cluster.client import NodeDownError, RemoteError
+
+        target = body.get("target")
+        if target:
+            node = Node(id=target.get("id", ""), uri=target.get("uri", ""))
+            try:
+                out = self.client.membership_ping(
+                    node, {"from": self.node.id,
+                           "relay_for": body.get("from")})
+                return {"ok": bool(out.get("ok")), "relay": self.node.id}
+            except (NodeDownError, RemoteError):
+                return {"ok": False, "relay": self.node.id}
+        # answer even with membership off: an ack proves the process is
+        # up, which is all the prober needs
+        m = self.membership
+        return {"ok": True, "node": self.node.id,
+                "inc": m.incarnation if m is not None else 0}
+
+    def membership_json(self) -> dict:
+        """GET /internal/membership payload."""
+        m = self.membership
+        if m is None:
+            return {"enabled": False, "node": self.node.id,
+                    "live": sorted(self.disco.live_ids())}
+        return m.members_json()
 
     # -- crash recovery + replica catch-up (storage/recovery.py) -----------
 

@@ -326,6 +326,21 @@ class _FaultRule:
         return True
 
 
+class _LinkRule(_FaultRule):
+    """A directed network-partition rule: drop requests whose SOURCE is
+    in ``srcs`` and TARGET in ``dsts``. Only acts when the client
+    declares its identity (``InternalClient.self_id``, set by
+    ClusterNode) — an anonymous client sees no link faults, so external
+    callers and test doubles are unaffected."""
+
+    __slots__ = ("srcs", "dsts")
+
+    def __init__(self, srcs, dsts, **kw):
+        super().__init__("partition", **kw)
+        self.srcs = frozenset(srcs)
+        self.dsts = frozenset(dsts)
+
+
 class FaultPlan:
     """Seeded, deterministic faults at the internode-RPC boundary.
 
@@ -361,6 +376,7 @@ class FaultPlan:
         self._sleep = sleep if sleep is not None else time.sleep
         self._lock = threading.Lock()
         self._rules: Dict[str, List[_FaultRule]] = {}
+        self._links: List[_LinkRule] = []
         self._counts: Dict[str, int] = {}
         self.events: List[Tuple[str, int, str]] = []  # (node, k, action)
 
@@ -391,6 +407,34 @@ class FaultPlan:
                        op=op))
         return self
 
+    def partition(self, nodes_a, nodes_b, *, symmetric: bool = True,
+                  op: Optional[str] = None, first: int = 0,
+                  count: Optional[int] = None,
+                  prob: Optional[float] = None) -> "FaultPlan":
+        """Network partition between node sets A and B: requests whose
+        declared source is on one side and target on the other raise
+        :class:`InjectedFault`. ``symmetric=False`` drops only the
+        A->B direction (the asymmetric-link case: A cannot reach B but
+        B still reaches A). ``op`` scopes the cut to one RPC boundary
+        (e.g. ``op="ping"`` severs only membership probes while gossip
+        and queries deliver). ``first``/``count``/``prob`` use the
+        TARGET node's per-node arrival index, like every other rule.
+        Omit ``prob`` for a clean deterministic cut."""
+        a, b = list(nodes_a), list(nodes_b)
+        self._links.append(_LinkRule(a, b, op=op, first=first, count=count,
+                                     prob=prob))
+        if symmetric:
+            self._links.append(_LinkRule(b, a, op=op, first=first,
+                                         count=count, prob=prob))
+        return self
+
+    def heal(self) -> "FaultPlan":
+        """Remove every partition rule (per-node drop/delay/flap rules
+        stay; use :meth:`clear` for those)."""
+        with self._lock:
+            self._links.clear()
+        return self
+
     def seen(self, node_id: str) -> int:
         """Requests observed for ``node_id`` while rules were armed —
         the per-node index the NEXT matching request will get. Use as
@@ -415,10 +459,14 @@ class FaultPlan:
 
     def on_request(self, node_id: str,
                    token: Optional[CancellationToken] = None,
-                   op: Optional[str] = None) -> None:
+                   op: Optional[str] = None,
+                   source: Optional[str] = None) -> None:
         with self._lock:
             rules = list(self._rules.get(node_id, ()))
-            if not rules:
+            links = ([] if source is None else
+                     [l for l in self._links if source in l.srcs
+                      and node_id in l.dsts])
+            if not rules and not links:
                 return
             k = self._counts.get(node_id, 0)
             self._counts[node_id] = k + 1
@@ -426,6 +474,11 @@ class FaultPlan:
                 (r for r in rules
                  if r.matches(k, self._hit_rng(node_id, k), op)),
                 None)
+            if rule is None:
+                rule = next(
+                    (l for l in links
+                     if l.matches(k, self._hit_rng(node_id, k), op)),
+                    None)
             if rule is not None:
                 self.events.append((node_id, k, rule.kind))
         if rule is None:

@@ -20,6 +20,7 @@ using the same liveness signal as the query fan-out.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterable, List, Optional
 
 from pilosa_tpu.cluster.client import NodeDownError, RemoteError
@@ -34,8 +35,19 @@ class ClusterTranslator:
         self.client = client
         self._snapshot_fn = snapshot_fn  # () -> ClusterSnapshot
         self._live_fn = live_fn          # () -> set of live node ids
-        # (node, index, field) -> entries a down replica hasn't seen yet
+        # (node, index, field) -> entries a down replica hasn't seen yet.
+        # Every pop/requeue holds _outbox_lock and requeues EXTEND rather
+        # than overwrite: two concurrent creates whose sends both fail
+        # used to race pop-then-assign and one batch's entries could
+        # vanish — a promoted replica then re-allocated those ids to
+        # different keys (round-5 advisor finding).
         self._outbox: Dict[tuple, List] = {}
+        self._outbox_lock = threading.Lock()
+        # gossip hook (ClusterNode.enable_membership): fn(index, field,
+        # entries, batch_no) publishes new entries on the gossip plane so
+        # replicas a partition hides from US still converge via peers
+        self.gossip_publish = None
+        self._gossip_batch = 0
 
     def _first_live(self, owners, live=None):
         """READ failover: first live owner (reference: reads fail over
@@ -76,6 +88,15 @@ class ClusterTranslator:
 
     def _push_entries(self, index: str, field: Optional[str],
                       new: List) -> None:
+        if self.gossip_publish is not None:
+            with self._outbox_lock:
+                self._gossip_batch += 1
+                batch_no = self._gossip_batch
+            try:
+                self.gossip_publish(index, field,
+                                    [[k, int(i)] for k, i in new], batch_no)
+            except Exception:
+                pass  # gossip is a second channel; direct push still runs
         snap = self._snapshot_fn()
         by_node: Dict[str, List] = {}
         nodes = {}
@@ -91,16 +112,57 @@ class ClusterTranslator:
         for nid, entries in by_node.items():
             if nid == self.node_id:
                 continue
-            # a replica that missed earlier pushes catches up on the next
-            # one (per-node outbox; the reference tolerates a lagging
-            # EntryReader the same way — it replays from its position)
-            pending = self._outbox.pop((nid, index, field), [])
-            payload = pending + entries
-            try:
-                self.client.replicate_translate(
-                    nodes[nid], index, field, payload)
-            except (NodeDownError, RemoteError):
-                self._outbox[(nid, index, field)] = payload
+            self._send_with_outbox(nodes[nid], index, field, entries)
+
+    def _send_with_outbox(self, node, index: str, field: Optional[str],
+                          entries: List) -> bool:
+        """Send ``entries`` (plus any outbox backlog for this replica)
+        to one replica; a failed send requeues by APPEND under the lock,
+        so a concurrent create's requeue can never be overwritten."""
+        key = (node.id, index, field)
+        with self._outbox_lock:
+            pending = self._outbox.pop(key, [])
+        payload = pending + entries
+        try:
+            self.client.replicate_translate(node, index, field, payload)
+            return True
+        except (NodeDownError, RemoteError):
+            with self._outbox_lock:
+                # prepend: keep this batch ahead of entries queued while
+                # the send was in flight (apply is idempotent either way,
+                # but ordered replay keeps replica stores append-shaped)
+                self._outbox.setdefault(key, [])[:0] = payload
+            return False
+
+    def flush_outbox(self) -> int:
+        """Retry every queued replica push — called from the gossip
+        round hooks (the heartbeat path), so a recovered replica drains
+        within one round instead of waiting for the next create on the
+        same (replica, index, field). Returns entries drained."""
+        with self._outbox_lock:
+            keys = sorted(self._outbox.keys(),
+                          key=lambda t: (t[0], t[1], t[2] or ""))
+        if not keys:
+            return 0
+        nodes = {n.id: n for n in self._snapshot_fn().nodes}
+        live = set(self._live_fn()) if self._live_fn is not None else None
+        drained = 0
+        for key in keys:
+            nid, index, field = key
+            node = nodes.get(nid)
+            if node is None or (live is not None and nid not in live):
+                continue  # keep queued until the replica is back
+            with self._outbox_lock:
+                payload = self._outbox.pop(key, None)
+            if not payload:
+                continue
+            if self._send_with_outbox(node, index, field, payload):
+                drained += len(payload)
+        return drained
+
+    def outbox_depth(self) -> int:
+        with self._outbox_lock:
+            return sum(len(v) for v in self._outbox.values())
 
     # -- index (record) keys ----------------------------------------------
 

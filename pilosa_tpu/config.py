@@ -159,6 +159,18 @@ class Config:
     gossip_seed: int = 0  # deterministic peer selection seed
     gossip_max_deltas: int = 512  # entries per envelope (complete windows)
     gossip_piggyback: bool = True  # ride envelopes on query/import/broadcast
+    # gossip-native SWIM membership ([membership] section /
+    # PILOSA_TPU_MEMBERSHIP_*): incarnation-numbered alive/suspect/down
+    # records on the gossip plane, direct + indirect probing, bounded
+    # suspect timeouts (gossip/membership.py; attach via
+    # ClusterNode.enable_membership — requires gossip)
+    membership_enabled: bool = False
+    membership_interval_ms: float = 500.0  # protocol tick period
+    membership_ping_timeout_ms: float = 200.0  # direct/indirect probe cap
+    membership_indirect_k: int = 2  # ping-req relays before suspecting
+    # suspect timeout = tick interval x mult x log2(cluster size)
+    membership_suspect_mult: float = 3.0
+    membership_flap_window_s: float = 30.0  # flap-detection window
     # fan-out resilience ([cluster.resilience] section /
     # PILOSA_TPU_CLUSTER_RESILIENCE_*): hedged remote shard legs,
     # per-node circuit breakers, adaptive per-leg timeouts

@@ -14,10 +14,19 @@ import warnings
 from pilosa_tpu.gossip.state import (
     GossipState,
     KIND_BREAKER,
+    KIND_CONTROL,
     KIND_FRAGMENT,
     KIND_HEALTH,
+    KIND_MEMBER,
+    KIND_TRANSLATE,
 )
 from pilosa_tpu.gossip.agent import GossipAgent
+from pilosa_tpu.gossip.membership import (
+    MEMBER_ALIVE,
+    MEMBER_DOWN,
+    MEMBER_SUSPECT,
+    Membership,
+)
 
 _warned_remote_ttl = False
 
@@ -48,7 +57,14 @@ __all__ = [
     "GossipAgent",
     "GossipState",
     "KIND_BREAKER",
+    "KIND_CONTROL",
     "KIND_FRAGMENT",
     "KIND_HEALTH",
+    "KIND_MEMBER",
+    "KIND_TRANSLATE",
+    "MEMBER_ALIVE",
+    "MEMBER_DOWN",
+    "MEMBER_SUSPECT",
+    "Membership",
     "warn_remote_ttl_deprecated",
 ]

@@ -30,7 +30,7 @@ from __future__ import annotations
 import os
 import random
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from pilosa_tpu.obs import metrics as M
 from pilosa_tpu.sched.clock import MonotonicClock
@@ -69,6 +69,11 @@ class GossipAgent:
         # seed:node_id so every node in a seeded cluster draws a distinct
         # but reproducible peer sequence (FaultPlan's _hit_rng convention)
         self._rng = random.Random(f"{self.seed}:{node_id}")
+        # called once per anti-entropy round, before the exchange — the
+        # membership tick and translate-outbox flush ride here so cluster
+        # liveness and replication drain at gossip cadence with no extra
+        # threads (ClusterNode.enable_membership registers them)
+        self.round_hooks: List[Callable[[], None]] = []
         self._peer_digest: Dict[str, Dict[str, int]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -128,6 +133,11 @@ class GossipAgent:
         t0 = self.clock.now()
         self.refresh_local()
         self.state.record_health()
+        for hook in list(self.round_hooks):
+            try:
+                hook()
+            except Exception:
+                pass  # hooks are best-effort; the round must still run
         peers = sorted((p for p in self.peers_fn()
                         if p.id != self.node_id), key=lambda p: p.id)
         if not peers:

@@ -43,6 +43,17 @@ from pilosa_tpu.sched.clock import MonotonicClock
 KIND_FRAGMENT = "f"
 KIND_BREAKER = "b"
 KIND_HEALTH = "h"
+# SWIM membership observation: ("m", target) -> [status, incarnation]
+# published under each OBSERVER's origin (gossip/membership.py)
+KIND_MEMBER = "m"
+# control-plane broadcast: ("c", n) -> message dict, n a per-origin
+# counter so every message gets its own seq and applies exactly once
+# per receiver in origin order (cluster/broadcast.GossipBroadcaster)
+KIND_CONTROL = "c"
+# translate replication: ("t", index, field-or-"", batch) -> entry list
+# (cluster/translator.py; grow-only key->id maps, primary-only
+# allocation makes cross-origin application conflict-free)
+KIND_TRANSLATE = "t"
 
 # mirrors cache/keys.py sentinel: dataframe frames version under a field
 # name no real field can use
@@ -102,6 +113,23 @@ class GossipState:
         self._lock = threading.Lock()
         self._entries: Dict[str, Dict[Tuple, _Entry]] = {node_id: {}}
         self._max_seq: Dict[str, int] = {node_id: 0}
+        # generic per-kind apply listeners: fn(origin, key, value) fires
+        # for every entry of that kind APPLIED from a remote origin (the
+        # same contract as on_breaker, which predates this registry) —
+        # membership records, control broadcasts and translate batches
+        # all hook here
+        self._kind_listeners: Dict[
+            str, List[Callable[[str, Tuple, Any], None]]] = {}
+
+    def add_kind_listener(self, kind: str,
+                          fn: Callable[[str, Tuple, Any], None]) -> None:
+        self._kind_listeners.setdefault(kind, []).append(fn)
+
+    def remove_kind_listener(self, kind: str, fn) -> None:
+        try:
+            self._kind_listeners.get(kind, []).remove(fn)
+        except ValueError:
+            pass
 
     # -- local bumps -------------------------------------------------------
 
@@ -194,6 +222,7 @@ class GossipState:
         apply staleness. Returns entries applied."""
         applied = 0
         breaker_cbs: List[Tuple[str, str, str]] = []
+        kind_cbs: List[Tuple[Callable, str, Tuple, Any]] = []
         now = self.clock.now()
         with self._lock:
             for d in deltas:
@@ -218,11 +247,28 @@ class GossipState:
                         M.GOSSIP_STALENESS_BUCKETS_MS)
                 if key[0] == KIND_BREAKER and self.on_breaker is not None:
                     breaker_cbs.append((origin, key[1], d.get("v")))
+                for fn in self._kind_listeners.get(key[0], ()):
+                    kind_cbs.append((fn, origin, key, d.get("v")))
             if applied:
                 self._update_gauges_locked()
         for origin, target, state in breaker_cbs:
             self.on_breaker(origin, target, state)
+        for fn, origin, key, value in kind_cbs:
+            fn(origin, key, value)
         return applied
+
+    def entries_of_kind(self, kind: str) -> List[Tuple[str, Tuple, Any]]:
+        """Every held (origin, key, value) whose key is of ``kind``,
+        sorted (origin, key) — the membership layer's merged-view scan.
+        Includes this node's own entries (our observations count)."""
+        out: List[Tuple[str, Tuple, Any]] = []
+        with self._lock:
+            for origin in sorted(self._entries):
+                ent = [(key, e.value) for key, e in
+                       self._entries[origin].items() if key[0] == kind]
+                for key, value in sorted(ent, key=lambda kv: kv[0]):
+                    out.append((origin, key, value))
+        return out
 
     # -- cache fingerprints ------------------------------------------------
 

@@ -15,6 +15,9 @@ triggers
 - ``wal_stall``        a WAL has held unflushed records longer than the
                        stall threshold (a stuck group commit)
 - ``slow_query_burst`` slow-query log rate above threshold
+- ``membership_flap`` membership status transitions inside the flap
+                      window crossed the threshold (a link or node
+                      oscillating alive<->suspect — gossip/membership.py)
 
 bundle contents: the trailing timeline window, SLO status, slow traces
 from the trace store (IDs resolve at /internal/traces/{id}), the
@@ -49,6 +52,7 @@ class FlightRecorder:
                  eviction_rate: float = 10.0,
                  wal_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
+                 flap_transitions: float = 6.0,
                  dump_dir: str = "",
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  clock=None):
@@ -57,6 +61,7 @@ class FlightRecorder:
         self.eviction_rate = float(eviction_rate)
         self.wal_stall_s = float(wal_stall_s)
         self.slow_burst_per_s = float(slow_burst_per_s)
+        self.flap_transitions = float(flap_transitions)
         self.dump_dir = dump_dir or ""
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or WallClock()
@@ -135,6 +140,16 @@ class FlightRecorder:
                 b = self.trigger(
                     "wal_stall",
                     f"WAL unflushed for {lag:.1f}s", sample)
+                if b:
+                    fired.append(b)
+
+        mem = probes.get("membership")
+        if isinstance(mem, dict):
+            flaps = mem.get("recent_transitions", 0) or 0
+            if flaps >= self.flap_transitions:
+                b = self.trigger(
+                    "membership_flap",
+                    f"{flaps} membership transitions in window", sample)
                 if b:
                     fired.append(b)
 

@@ -79,6 +79,7 @@ class HealthPlane:
                  eviction_rate: float = 10.0,
                  wal_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
+                 membership_flap_transitions: float = 6.0,
                  dump_dir: str = "",
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  clock=None, node_id: str = "local"):
@@ -97,6 +98,7 @@ class HealthPlane:
             capacity=flight_capacity, cooldown_s=flight_cooldown_s,
             bundle_window_s=bundle_window_s, eviction_rate=eviction_rate,
             wal_stall_s=wal_stall_s, slow_burst_per_s=slow_burst_per_s,
+            flap_transitions=membership_flap_transitions,
             dump_dir=dump_dir, registry=self.registry, clock=self.clock)
         self.flight.bind(self)
         # the slo probe re-evaluates burn on every sample: the sample's
@@ -166,8 +168,15 @@ class HealthPlane:
             return {"enabled": True, "origins": ages,
                     "staleness_s": max(ages.values(), default=0.0)}
 
+        def membership():
+            m = getattr(node, "membership", None)
+            if m is None:
+                return {"enabled": False}
+            return m.probe()
+
         self.timeline.add_probe("breakers", breakers)
         self.timeline.add_probe("gossip", gossip)
+        self.timeline.add_probe("membership", membership)
 
     def on_breaker_transition(self, node_id: str, frm: str,
                               to: str) -> None:

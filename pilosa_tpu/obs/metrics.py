@@ -116,6 +116,14 @@ METRIC_GOSSIP_ORIGINS = "gossip_known_origins"
 METRIC_GOSSIP_ROUND_MS = "gossip_round_ms"  # histogram
 METRIC_GOSSIP_STALENESS_MS = "gossip_apply_staleness_ms"  # histogram
 METRIC_GOSSIP_BREAKER_PREWARMS = "gossip_breaker_prewarms_total"
+# SWIM membership (gossip/membership.py): per-node merged status gauge
+# (0=alive 1=suspect 2=down), status transitions by target node and new
+# status, probe outcomes (ok / fail), and self-refutations (incarnation
+# bumps answering a false suspicion)
+METRIC_MEMBERSHIP_STATUS = "membership_status"
+METRIC_MEMBERSHIP_TRANSITIONS = "membership_transitions_total"
+METRIC_MEMBERSHIP_PINGS = "membership_pings_total"
+METRIC_MEMBERSHIP_REFUTATIONS = "membership_refutations_total"
 # a loopback anti-entropy round is a couple of HTTP exchanges (~1-10ms);
 # staleness spans one piggyback hop up to several missed rounds
 GOSSIP_ROUND_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,

@@ -124,6 +124,10 @@ _ROUTES = [
     ("POST", re.compile(r"^/internal/gossip/exchange$"),
      "post_gossip_exchange"),
     ("GET", re.compile(r"^/internal/gossip/state$"), "get_gossip_state"),
+    # SWIM membership (gossip/membership.py): probe/relay + merged view
+    ("POST", re.compile(r"^/internal/membership/ping$"),
+     "post_membership_ping"),
+    ("GET", re.compile(r"^/internal/membership$"), "get_membership"),
     # replica catch-up log shipping (storage/recovery.py): shard
     # snapshot + WAL tail, JSON+base64 like every internal route
     ("GET", re.compile(r"^/internal/recovery/snapshot$"),
@@ -1012,6 +1016,21 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, {"enabled": False})
             return
         self._send(200, {"enabled": True, **agent.state_json()})
+
+    def post_membership_ping(self):
+        """SWIM direct probe / ping-req relay. The piggybacked envelope
+        applies FIRST, so the ping that carries a suspicion of US
+        triggers the refutation before we build the reply — the refuting
+        alive record rides back on this very response."""
+        self._node_only()
+        b = self._json_body()
+        peer = self._gossip_apply(b)
+        out = self.api.membership_ping(b)
+        self._send(200, self._gossip_reply(peer, out))
+
+    def get_membership(self):
+        self._node_only()
+        self._send(200, self.api.membership_json())
 
     def get_recovery_snapshot(self):
         """One shard's snapshot + the WAL LSN it covers, for replica

@@ -41,6 +41,18 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== membership-chaos lane (PILOSA_TPU_FAULT_SEED=1 / 7) =="
+# SWIM membership must converge for ANY fault seed: partition plans in
+# test_membership are deterministic cuts (no prob rules), so the seed
+# only steers the other suites' prob-gated faults; the lane proves the
+# suspect/confirm/refute machinery and the cluster fan-out both hold
+# under two distinct injected-fault schedules.
+for seed in 1 7; do
+    PILOSA_TPU_FAULT_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_membership.py tests/test_cluster.py \
+        -q -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== crash-injection lane (PILOSA_TPU_CRASH_SEED=1 / 7) =="
 # Crash recovery must hold for ANY seeded kill point (the seed picks the
 # kill site and hit count); two fixed seeds exercise two distinct crash

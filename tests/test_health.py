@@ -423,6 +423,29 @@ class TestFlightRecorder:
         assert [b["trigger"] for b in hp.flight.bundles()] \
             == ["slow_query_burst"]
 
+    def test_membership_flap_trigger(self):
+        clock, reg = ManualClock(), M.MetricsRegistry()
+        hp = _plane(clock, reg, membership_flap_transitions=6.0)
+        flaps = {"n": 2}
+        hp.timeline.add_probe(
+            "membership",
+            lambda: {"enabled": True, "alive": 3, "suspect": 0, "down": 0,
+                     "recent_transitions": flaps["n"]})
+        hp.timeline.sample()
+        assert hp.flight.bundles() == []  # 2 transitions: normal churn
+        clock.advance(1.0)
+        flaps["n"] = 7
+        hp.timeline.sample()
+        bundles = hp.flight.bundles()
+        assert [b["trigger"] for b in bundles] == ["membership_flap"]
+        assert "7 membership transitions" in bundles[0]["reason"]
+
+    def test_membership_probe_absent_never_fires(self):
+        clock, reg = ManualClock(), M.MetricsRegistry()
+        hp = _plane(clock, reg, membership_flap_transitions=1.0)
+        hp.timeline.sample()  # no membership probe attached at all
+        assert hp.flight.bundles() == []
+
     def test_cooldown_bounds_refires(self):
         clock, reg = ManualClock(), M.MetricsRegistry()
         hp = _plane(clock, reg, wal_stall_s=1.0, flight_cooldown_s=30.0)
