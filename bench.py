@@ -1385,6 +1385,153 @@ def bench_config16(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 17 — sustained-rate streaming ingest (stream/)
+# ---------------------------------------------------------------------------
+
+def bench_config17(device: str) -> None:
+    """Streaming-ingest gate (stream/): three phases over one 2M-row
+    workload.
+
+    1. control — the current c1 ingest path (columnar CSV through the
+       classic single-threaded Ingester), best-of-2: the rows/s the
+       acceptance bar doubles.
+    2. pipelined — the same rows as chunked stream messages
+       (broker.make_chunk, the Kafka batch-per-message production
+       shape) through PipelinedIngester, best-of-3. HARD asserts:
+       >= 2x the control rows/s AND a bit-identical checksum vs the
+       classic Ingester draining the SAME broker stream (the oracle).
+    3. read protection — heavy GroupBy p50/p99 with the admission
+       scheduler on, alone vs under a concurrent full-rate re-ingest
+       churn (the churn re-applies the same rows, so the read working
+       set stays fixed and the ratio isolates contention). HARD
+       asserts: p50 and p99 within 1.5x, churn actually overlapped the
+       reads, and the checksum is unchanged after the churn (idempotent
+       re-application).
+    """
+    import threading
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.ingest.ingest import Ingester
+    from pilosa_tpu.ingest.source import CSVSource, _parse_header
+    from pilosa_tpu.stream.broker import (BrokerSource, StreamBroker,
+                                          make_chunk)
+    from pilosa_tpu.stream.pipeline import PipelinedIngester
+
+    rng = np.random.default_rng(17)
+    n = _n(2_000_000)
+    city = rng.integers(0, 100, n)
+    dev = rng.integers(0, 10, n)
+
+    # phase 1: the control — what `bench.py --configs 1` measures today
+    lines = ["id,city__IS,device__IS"]
+    lines.extend(f"{i},{city[i]},{dev[i]}" for i in range(n))
+    csv_text = "\n".join(lines)
+    c1_rows_s = 0.0
+    for _ in range(2):
+        api = API()
+        t0 = time.perf_counter()
+        got = Ingester(api, "s17", CSVSource(csv_text, inline=True),
+                       batch_size=131072).run()
+        c1_rows_s = max(c1_rows_s, n / (time.perf_counter() - t0))
+        assert got == n, got
+    del csv_text, lines
+
+    # the stream: chunked messages, produced once, drained by the
+    # classic oracle and the timed pipelined runs as separate groups
+    chunk = 8192
+    broker = StreamBroker(partitions=1, seed=17)
+    ids = np.arange(n)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        broker.produce("s17", make_chunk({
+            "id": ids[lo:hi], "city": city[lo:hi], "device": dev[lo:hi]}))
+    schema = _parse_header(["city__IS", "device__IS"])
+
+    api_cl = API()
+    got = Ingester(api_cl, "s17",
+                   BrokerSource(broker.consumer("classic", ["s17"]),
+                                schema),
+                   batch_size=131072).run()
+    assert got == n, got
+    oracle = api_cl.checksum()
+
+    # phase 2: pipelined over the same stream, best-of-3
+    piped_rows_s, api_rd = 0.0, None
+    for t in range(3):
+        api_pp = API()
+        p = PipelinedIngester(api_pp, "s17",
+                              broker.consumer(f"piped{t}", ["s17"]),
+                              schema=schema, batch_rows=32)
+        t0 = time.perf_counter()
+        got = p.run()
+        piped_rows_s = max(piped_rows_s, n / (time.perf_counter() - t0))
+        assert got == n, got
+        assert api_pp.checksum() == oracle, \
+            "pipelined ingest diverged from the classic Ingester oracle"
+        api_rd = api_pp
+    assert piped_rows_s >= 2.0 * c1_rows_s, \
+        f"pipelined {piped_rows_s:,.0f} rows/s < 2x classic " \
+        f"{c1_rows_s:,.0f} rows/s"
+    _emit(f"c17_stream_pipelined_ingest_2M_rows{SCALED} ({device})",
+          piped_rows_s, "rows/s", piped_rows_s / c1_rows_s,
+          classic_rows_s=c1_rows_s, chunk_rows=chunk)
+
+    # phase 3: read p50/p99 alone vs under full-rate ingest churn
+    api_rd.enable_scheduler()
+    q = "GroupBy(Rows(city), Rows(device), limit=100)"
+    want = int(np.sum((city == 7) & (dev == 3)))
+    assert api_rd.query(
+        "s17", "Count(Intersect(Row(city=7), Row(device=3)))")[0] == want
+
+    def percentiles(iters):
+        api_rd.query("s17", q)  # warm
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            api_rd.query("s17", q)
+            times.append(time.perf_counter() - t0)
+        return (float(np.percentile(times, 50)) * 1e3,
+                float(np.percentile(times, 99)) * 1e3)
+
+    iters = max(25, QUERY_ITERS * 5)
+    p50_alone, p99_alone = percentiles(iters)
+
+    stop = threading.Event()
+    churned = [0]
+
+    def churn():
+        w = 0
+        while not stop.is_set():
+            w += 1
+            c = PipelinedIngester(
+                api_rd, "s17", broker.consumer(f"churn{w}", ["s17"]),
+                schema=schema, batch_rows=8, group=f"churn{w}")
+            churned[0] += c.run()
+
+    th = threading.Thread(target=churn, daemon=True)
+    th.start()
+    while churned[0] == 0:  # ensure the churn is live before timing
+        time.sleep(0.005)
+    p50_busy, p99_busy = percentiles(iters)
+    still_churning = th.is_alive()
+    stop.set()
+    th.join(timeout=30)
+    assert still_churning and churned[0] >= n, \
+        f"churn did not overlap the reads ({churned[0]} rows)"
+    assert api_rd.checksum() == oracle, \
+        "idempotent re-ingest changed the checksum"
+    assert p50_busy <= 1.5 * p50_alone, \
+        f"read p50 {p50_busy:.1f}ms vs {p50_alone:.1f}ms alone"
+    assert p99_busy <= 1.5 * p99_alone, \
+        f"read p99 {p99_busy:.1f}ms vs {p99_alone:.1f}ms alone"
+    _emit(f"c17_read_p50_under_full_rate_ingest{SCALED} ({device})",
+          p50_busy, "ms", p50_alone / p50_busy,
+          p50_alone_ms=p50_alone, p99_alone_ms=p99_alone,
+          p99_busy_ms=p99_busy, churn_rows=churned[0],
+          floor_ms=dispatch_floor_ms())
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -1541,6 +1688,7 @@ _CONFIGS = {
     "14": bench_config14,
     "15": bench_config15,
     "16": bench_config16,
+    "17": bench_config17,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

@@ -60,6 +60,10 @@ class API:
         # sampler + SLO burn tracking + flight recorder. None = the
         # query/import paths pay one attribute check.
         self.health = None
+        # optional streaming ingest service (stream/): in-process broker
+        # topic + pipelined exactly-once ingester. None = off; enabled
+        # via enable_stream (config [stream] / PILOSA_TPU_STREAM_*).
+        self.stream = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
             self.holder.recover()
@@ -164,6 +168,32 @@ class API:
         if getattr(self, "_health_set_exemplars", False):
             M.REGISTRY.exemplars = False
             self._health_set_exemplars = False
+
+    # -- streaming ingest (stream/: broker + pipelined ingester) -----------
+
+    def enable_stream(self, index: str, config=None, **overrides):
+        """Attach the continuous-ingest service for ``index``: an
+        in-process Kafka-shaped broker topic feeding the two-stage
+        pipelined ingester with exactly-once WAL offsets. ``config`` is a
+        pilosa_tpu.config.Config ([stream]); kwargs override individual
+        StreamService knobs (schema, topic, group, partitions,
+        batch_rows, queue_depth, max_backlog_rows, id_field, keys, clock,
+        plan). Records arrive via ``api.stream.push`` (the HTTP
+        ``POST /index/{index}/stream/push`` surface) or direct
+        ``api.stream.broker.produce``; ``api.stream.step()`` drains them
+        through the pipeline."""
+        from pilosa_tpu.stream.pipeline import StreamService
+
+        if self.stream is not None:
+            self.disable_stream()
+        self.stream = StreamService.from_config(self, index, config=config,
+                                                **overrides)
+        return self.stream
+
+    def disable_stream(self) -> None:
+        svc, self.stream = self.stream, None
+        if svc is not None:
+            svc.close()
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -573,7 +603,12 @@ class API:
 
     def checksum(self) -> str:
         """Deterministic digest of all data — compare across replicas
-        (reference: ctl/chksum.go cluster checksum)."""
+        (reference: ctl/chksum.go cluster checksum).
+
+        Rows hash in row-id order, not insertion order: two holders with
+        the same bits digest equal even when their ingest paths created
+        rows in a different sequence (classic vs pipelined batching) —
+        content compare, not history compare."""
         import hashlib
 
         h = hashlib.sha256()
@@ -591,10 +626,12 @@ class API:
                             frag = field.views[view][shard]
                             h.update(f"{iname}/{fname}/{view}/{shard}".encode())
                             n = len(frag.row_ids)
-                            h.update(np.asarray(frag.row_ids,
-                                                dtype=np.uint64).tobytes())
+                            rows = np.asarray(frag.row_ids,
+                                              dtype=np.uint64)
+                            order = np.argsort(rows, kind="stable")
+                            h.update(rows[order].tobytes())
                             h.update(np.ascontiguousarray(
-                                frag.planes[:n]).tobytes())
+                                np.asarray(frag.planes[:n])[order]).tobytes())
                     for shard in sorted(field.bsi):
                         h.update(f"{iname}/{fname}/bsi/{shard}".encode())
                         h.update(np.ascontiguousarray(

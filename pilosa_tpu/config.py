@@ -141,6 +141,9 @@ class Config:
     scheduler_adaptive_window: bool = False
     scheduler_window_min_ms: float = 0.2
     scheduler_window_max_ms: float = 5.0
+    # batch-priority admits (streaming-ingest applies) yield until reads
+    # have been quiet this long — the write side of read protection
+    scheduler_batch_holdoff_ms: float = 5.0
     # result cache ([cache] section / PILOSA_TPU_CACHE_*): version-keyed
     # read result caching + single-flight dedup (cache/)
     cache_enabled: bool = False
@@ -216,6 +219,20 @@ class Config:
     storage_recovery_checkpoint_interval_bytes: int = 0
     # max shipped WAL-tail bytes per catch-up fetch
     storage_recovery_catchup_batch_bytes: int = 1 << 20
+    # streaming ingest ([stream] section / PILOSA_TPU_STREAM_*): the
+    # continuous-ingest service (stream/pipeline.py; attach via
+    # API.enable_stream). Batch rows per pipeline hand-off, bounded
+    # queue depth (2 = double-buffered), the consumer group name, the
+    # broker backlog at which the push endpoint starts 429ing (0 =
+    # batch_rows * queue_depth * 8), and the paused/saturated stall
+    # seconds that fire the flight recorder's ingest_stall trigger
+    stream_enabled: bool = False
+    stream_index: str = ""  # target index; required when enabled
+    stream_batch_rows: int = 8192
+    stream_queue_depth: int = 2
+    stream_group: str = "ingest"
+    stream_max_backlog_rows: int = 0
+    stream_ingest_stall_s: float = 5.0
 
     # -- sources -----------------------------------------------------------
 

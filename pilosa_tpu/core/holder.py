@@ -197,12 +197,19 @@ class Holder:
             lsns = {name: idx.wal.last_lsn
                     for name, idx in self.indexes.items()
                     if idx.wal is not None}
+            # stream watermarks captured under the same lock as the LSNs:
+            # the stamp must describe exactly the state the snapshot holds
+            offsets = {name: {g: dict(m)
+                              for g, m in idx.stream_offsets.items()}
+                       for name, idx in self.indexes.items()
+                       if idx.stream_offsets}
             with crash_scope(plan):
                 save_holder_data(self)
                 if plan is not None and not plan.fire("checkpoint.mid"):
                     return
                 for name, lsn in lsns.items():
-                    write_checkpoint_meta(self._index_path(name), lsn)
+                    write_checkpoint_meta(self._index_path(name), lsn,
+                                          stream_offsets=offsets.get(name))
             for name, lsn in lsns.items():
                 idx = self.indexes.get(name)
                 if idx is not None and idx.wal is not None:
@@ -252,7 +259,8 @@ class Holder:
         rbf/db.go WAL replay on open; op-level like dax/storage
         snapshot+log resume)."""
         from pilosa_tpu.obs import metrics as M
-        from pilosa_tpu.storage.recovery import read_checkpoint_meta
+        from pilosa_tpu.storage.recovery import (read_checkpoint_meta,
+                                                 read_checkpoint_offsets)
         from pilosa_tpu.storage.store import load_holder_data
 
         load_holder_data(self)
@@ -260,6 +268,13 @@ class Holder:
             if idx.wal is None:
                 continue
             ckpt = read_checkpoint_meta(self._index_path(name))
+            # checkpoint-stamped stream watermarks first; the WAL tail's
+            # stream_offsets records replayed below only move them forward
+            for g, m in read_checkpoint_offsets(
+                    self._index_path(name)).items():
+                cur = idx.stream_offsets.setdefault(g, {})
+                for k, v in m.items():
+                    cur[k] = max(int(v), int(cur.get(k, 0)))
             nbytes = [0]
 
             def _tail(w=idx.wal, after=ckpt, nb=nbytes):
@@ -282,6 +297,11 @@ class Holder:
         from pilosa_tpu.storage.wal import unpack_plane
 
         op, fname = rec[0], rec[1]
+        if op == "stream_offsets":  # consumer watermark; rec[1] is a group
+            cur = idx.stream_offsets.setdefault(fname, {})
+            for k, v in dict(rec[2]).items():
+                cur[k] = max(int(v), int(cur.get(k, 0)))
+            return
         if op == "df_changeset":  # dataframe record, no field name
             _, _, shard, ids, columns = rec
             idx.dataframe.apply_changeset(shard, ids, columns, log=False)

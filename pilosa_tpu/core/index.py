@@ -44,6 +44,12 @@ class Index:
         )
         if self.options.track_existence:
             self._create_field_object(EXISTENCE_FIELD, FieldOptions(type=FieldType.SET))
+        # Per-consumer-group stream watermarks ({group: {"topic:partition"
+        # -> next offset}}), maintained by ``stream_offsets`` WAL records
+        # (stream/pipeline.py) and stamped into checkpoint.json so they
+        # survive segment pruning. Excluded from checksum(): the pipelined
+        # path must stay bit-identical to the classic Ingester oracle.
+        self.stream_offsets: Dict[str, Dict[str, int]] = {}
         from pilosa_tpu.dataframe.store import DataframeStore
 
         self.dataframe = DataframeStore(

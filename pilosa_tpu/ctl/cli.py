@@ -50,6 +50,10 @@ def cmd_server(args) -> int:
         api.enable_scheduler(cfg)
     if cfg.cache_enabled:
         api.enable_cache(cfg)
+    if cfg.stream_enabled:
+        if not cfg.stream_index:
+            raise SystemExit("stream.enabled requires stream.index")
+        api.enable_stream(cfg.stream_index, cfg).start()
     if cfg.query_log_path:
         api.set_query_logger(cfg.query_log_path)
     auth = None

@@ -16,7 +16,7 @@ Source for the Ingester) or via the CLI:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, List, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,11 +37,66 @@ def scenarios() -> List[str]:
     return sorted(_SCENARIOS)
 
 
-def scenario(name: str, rows: int = 1000, seed: int = 1) -> Source:
+def scenario(name: str, rows: int = 1000, seed: int = 1,
+             rate_rows_s: Optional[float] = None, clock=None) -> Source:
+    """A named synthetic Source.
+
+    With ``rate_rows_s`` the source streams: records are released at the
+    given rate against ``clock`` (sched/clock.py), modeling a live feed
+    for the streaming ingest pipeline. A ManualClock makes the pacing
+    fully deterministic — the wrapper advances the clock itself instead
+    of sleeping, so tests and benches never wall-block.
+    """
     if name not in _SCENARIOS:
         raise KeyError(
             f"unknown scenario {name!r}; have {', '.join(scenarios())}")
-    return _SCENARIOS[name](rows, seed)
+    src = _SCENARIOS[name](rows, seed)
+    if rate_rows_s is not None:
+        src = _RateLimitedSource(src, rate_rows_s, clock=clock)
+    return src
+
+
+class _RateLimitedSource(Source):
+    """Release an inner source's records at a fixed rows/s.
+
+    Record ``i`` becomes due at ``t0 + i / rate``. Against a ManualClock
+    (detected by its ``advance`` method) the wrapper advances time to the
+    due instant — zero wall sleeps, bit-reproducible pacing. Against a
+    real clock it waits out the remaining interval.
+    """
+
+    def __init__(self, inner: Source, rate_rows_s: float, clock=None):
+        if rate_rows_s <= 0:
+            raise ValueError("rate_rows_s must be positive")
+        from pilosa_tpu.sched.clock import MonotonicClock
+
+        self._inner = inner
+        self._rate = float(rate_rows_s)
+        self._clock = clock or MonotonicClock()
+
+    def schema(self):
+        return self._inner.schema()
+
+    def id_column(self):
+        return self._inner.id_column()
+
+    def records(self):
+        clock = self._clock
+        manual = hasattr(clock, "advance")
+        t0 = clock.now()
+        released = 0
+        for rec in self._inner.records():
+            due = t0 + released / self._rate
+            now = clock.now()
+            if now < due:
+                if manual:
+                    clock.advance(due - now)
+                else:
+                    import time
+
+                    time.sleep(due - now)
+            yield rec
+            released += 1
 
 
 class _GenSource(Source):

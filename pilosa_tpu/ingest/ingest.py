@@ -36,9 +36,16 @@ class Ingester:
         if self.index not in holder.indexes:
             self.api.create_index(self.index, {"keys": self.keys})
         idx = holder.index(self.index)
+        created = False
         for name, opts in self.source.schema():
             if name not in idx.fields:
                 idx.create_field(name, opts)
+                created = True
+        if created:
+            # index-level create_field skips the API layer's schema.json
+            # write; a crash mid-ingest would otherwise replay the WAL
+            # into an index with no fields
+            holder.save_schema()
 
     def run(self) -> int:
         """Ingest everything; returns record count (reference:

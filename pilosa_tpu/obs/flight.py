@@ -15,6 +15,9 @@ triggers
 - ``wal_stall``        a WAL has held unflushed records longer than the
                        stall threshold (a stuck group commit)
 - ``slow_query_burst`` slow-query log rate above threshold
+- ``ingest_stall``     the streaming ingest pipeline is saturated or its
+                       consumer has been paused past the stall threshold
+                       (device stages not keeping up — stream/pipeline.py)
 - ``membership_flap`` membership status transitions inside the flap
                       window crossed the threshold (a link or node
                       oscillating alive<->suspect — gossip/membership.py)
@@ -51,6 +54,7 @@ class FlightRecorder:
                  bundle_window_s: float = 60.0,
                  eviction_rate: float = 10.0,
                  wal_stall_s: float = 5.0,
+                 ingest_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
                  flap_transitions: float = 6.0,
                  dump_dir: str = "",
@@ -60,6 +64,7 @@ class FlightRecorder:
         self.bundle_window_s = float(bundle_window_s)
         self.eviction_rate = float(eviction_rate)
         self.wal_stall_s = float(wal_stall_s)
+        self.ingest_stall_s = float(ingest_stall_s)
         self.slow_burst_per_s = float(slow_burst_per_s)
         self.flap_transitions = float(flap_transitions)
         self.dump_dir = dump_dir or ""
@@ -140,6 +145,18 @@ class FlightRecorder:
                 b = self.trigger(
                     "wal_stall",
                     f"WAL unflushed for {lag:.1f}s", sample)
+                if b:
+                    fired.append(b)
+
+        stream = probes.get("stream")
+        if isinstance(stream, dict) and stream.get("enabled"):
+            paused = stream.get("paused_s", 0.0) or 0.0
+            if stream.get("saturated") or paused >= self.ingest_stall_s:
+                why = ("backlog saturated" if stream.get("saturated")
+                       else f"consumer paused {paused:.1f}s")
+                b = self.trigger(
+                    "ingest_stall",
+                    f"streaming ingest stalled: {why}", sample)
                 if b:
                     fired.append(b)
 

@@ -63,6 +63,13 @@ def _wal_probe(holder):
             "last_lsn": holder.last_lsn()}
 
 
+def _stream_probe(owner):
+    svc = getattr(owner, "stream", None)
+    if svc is None:
+        return {"enabled": False}
+    return svc.stats()
+
+
 class HealthPlane:
     """Timeline sampler + SLO tracker + flight recorder, wired."""
 
@@ -78,6 +85,7 @@ class HealthPlane:
                  bundle_window_s: float = 60.0,
                  eviction_rate: float = 10.0,
                  wal_stall_s: float = 5.0,
+                 ingest_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
                  membership_flap_transitions: float = 6.0,
                  dump_dir: str = "",
@@ -97,7 +105,8 @@ class HealthPlane:
         self.flight = FlightRecorder(
             capacity=flight_capacity, cooldown_s=flight_cooldown_s,
             bundle_window_s=bundle_window_s, eviction_rate=eviction_rate,
-            wal_stall_s=wal_stall_s, slow_burst_per_s=slow_burst_per_s,
+            wal_stall_s=wal_stall_s, ingest_stall_s=ingest_stall_s,
+            slow_burst_per_s=slow_burst_per_s,
             flap_transitions=membership_flap_transitions,
             dump_dir=dump_dir, registry=self.registry, clock=self.clock)
         self.flight.bind(self)
@@ -120,6 +129,7 @@ class HealthPlane:
             flight_capacity=cfg.obs_timeline_flight_capacity,
             flight_cooldown_s=cfg.obs_timeline_flight_cooldown_s,
             dump_dir=cfg.obs_timeline_flight_dump_dir,
+            ingest_stall_s=cfg.stream_ingest_stall_s,
         )
         kw.update(overrides)
         return cls(**kw)
@@ -140,6 +150,8 @@ class HealthPlane:
         self.timeline.add_probe("wal", lambda: _wal_probe(api.holder))
         self.timeline.add_probe("residency",
                                 lambda: api.holder.residency_stats())
+        # streaming ingest saturation/pause feeds the ingest_stall trigger
+        self.timeline.add_probe("stream", lambda: _stream_probe(api))
         # kernel profiles ride every timeline sample, so flight-recorder
         # bundles capture MFU/roofline state at anomaly time
         self.timeline.add_probe("kernels", devprof.timeline_probe)

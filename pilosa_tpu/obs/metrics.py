@@ -209,6 +209,15 @@ METRIC_INGEST_STAGE_ROWS = "ingest_stage_rows_total"
 METRIC_INGEST_STAGE_BYTES = "ingest_stage_bytes_total"
 METRIC_INGEST_STAGE_ROWS_PER_S = "ingest_stage_rows_per_s"
 METRIC_INGEST_STAGE_BYTES_PER_S = "ingest_stage_bytes_per_s"
+# streaming ingest plane (stream/): rows/batches through the pipelined
+# path, hand-off credits + consumer lag gauges, shed device-stage
+# admissions (backpressure retries), and push-endpoint 429 rejections
+METRIC_STREAM_ROWS = "stream_ingest_rows_total"
+METRIC_STREAM_BATCHES = "stream_ingest_batches_total"
+METRIC_STREAM_CREDITS = "stream_pipeline_credits"
+METRIC_STREAM_LAG = "stream_consumer_lag"
+METRIC_STREAM_SHED = "stream_ingest_shed_total"
+METRIC_STREAM_REJECTED = "stream_push_rejected_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

@@ -119,6 +119,7 @@ class QueryScheduler:
                  fuse_waste_ratio: float = 2.0,
                  adaptive_window: bool = False,
                  window_min_ms: float = 0.2, window_max_ms: float = 5.0,
+                 batch_holdoff_ms: float = 5.0,
                  clock=None, registry=None):
         self.executor = executor
         self.window_s = max(0.0, float(window_ms)) / 1000.0
@@ -151,6 +152,15 @@ class QueryScheduler:
         self._paused = False
         self._closed = False
         self._inflight_admits = 0
+        # read protection: batch-priority admit tickets yield while
+        # interactive work is queued, dispatching, or admitted — and for
+        # batch_holdoff after the last read finishes, so back-to-back
+        # reads don't interleave with ingest applies (writes shed, reads
+        # keep the machine)
+        self.batch_holdoff_s = max(0.0, float(batch_holdoff_ms)) / 1e3
+        self._inflight_interactive = 0
+        self._dispatch_interactive = 0
+        self._last_interactive = float("-inf")
         self._worker = threading.Thread(
             target=self._loop, name="pilosa-sched", daemon=True)
         self._worker.start()
@@ -166,6 +176,7 @@ class QueryScheduler:
             adaptive_window=config.scheduler_adaptive_window,
             window_min_ms=config.scheduler_window_min_ms,
             window_max_ms=config.scheduler_window_max_ms,
+            batch_holdoff_ms=config.scheduler_batch_holdoff_ms,
         )
         kw.update(overrides)
         return cls(executor, **kw)
@@ -268,17 +279,37 @@ class QueryScheduler:
         return self.submit(index, query, shards, priority,
                            deadline_ms).result()
 
+    def _interactive_busy_locked(self) -> bool:
+        """Interactive work is queued, dispatching, holding an admit
+        ticket, or finished less than ``batch_holdoff`` ago (held lock)."""
+        if self._dispatch_interactive or self._inflight_interactive:
+            return True
+        rank = _PRIORITY_RANK[PRIORITY_INTERACTIVE]
+        if any(p.rank == rank for p in self._queue):
+            return True
+        return self.clock.now() < self._last_interactive + \
+            self.batch_holdoff_s
+
     @contextlib.contextmanager
     def admit(self, priority: str = PRIORITY_INTERACTIVE):
         """Admission-control-only ticket for work the batcher cannot fuse
-        (SQL scans): bounds concurrent admitted work by ``max_queue``
-        without routing execution through the queue."""
+        (SQL scans, streaming-ingest applies): bounds concurrent admitted
+        work by ``max_queue`` without routing execution through the
+        queue. Batch-priority tickets additionally yield whenever
+        interactive work is active — the caller is expected to back off
+        and retry, so sustained ingest sheds writes, never reads."""
         with self._cv:
             if self._closed:
                 raise AdmissionError("scheduler is closed")
             limit = self.max_queue
             if priority == PRIORITY_BATCH:
                 limit = max(1, self.max_queue // 2)
+                if self._interactive_busy_locked():
+                    self.registry.count(
+                        obs_metrics.METRIC_SCHED_REJECTED,
+                        priority=priority, reason="interactive_busy")
+                    raise AdmissionError(
+                        "interactive work active: batch admission yields")
             if self._inflight_admits + len(self._queue) >= limit:
                 self.registry.count(obs_metrics.METRIC_SCHED_REJECTED,
                                   priority=priority, reason="admit_full")
@@ -286,6 +317,8 @@ class QueryScheduler:
                     f"admission limit reached ({self._inflight_admits} "
                     f"inflight, limit {limit} for priority={priority})")
             self._inflight_admits += 1
+            if priority == PRIORITY_INTERACTIVE:
+                self._inflight_interactive += 1
             self.registry.gauge(obs_metrics.METRIC_SCHED_INFLIGHT,
                                 self._inflight_admits)
         try:
@@ -293,6 +326,9 @@ class QueryScheduler:
         finally:
             with self._cv:
                 self._inflight_admits -= 1
+                if priority == PRIORITY_INTERACTIVE:
+                    self._inflight_interactive -= 1
+                    self._last_interactive = self.clock.now()
                 self.registry.gauge(obs_metrics.METRIC_SCHED_INFLIGHT,
                                     self._inflight_admits)
 
@@ -323,13 +359,22 @@ class QueryScheduler:
     # -- worker ------------------------------------------------------------
 
     def _loop(self) -> None:
+        rank = _PRIORITY_RANK[PRIORITY_INTERACTIVE]
         while True:
             with self._cv:
                 batch = self._next_batch_locked()
                 if batch is None:
                     return
+                live = sum(1 for p in batch if p.rank == rank)
+                self._dispatch_interactive += live
             if batch:
-                self._dispatch(batch)
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cv:
+                        self._dispatch_interactive -= live
+                        if live:
+                            self._last_interactive = self.clock.now()
 
     def _next_batch_locked(self) -> Optional[List[_Pending]]:
         """Wait (held lock) until a group is ripe; take it. None = stop."""

@@ -148,6 +148,9 @@ _ROUTES = [
     # kernel performance attribution (obs/devprof.py): per-family
     # MFU/roofline profiles + ingest stage rates
     ("GET", re.compile(r"^/internal/stats/kernels$"), "get_stats_kernels"),
+    # streaming ingest (stream/): backpressured push + pipeline stats
+    ("POST", re.compile(r"^/index/([^/]+)/stream/push$"), "post_stream_push"),
+    ("GET", re.compile(r"^/internal/stats/stream$"), "get_stats_stream"),
     ("GET", re.compile(r"^/internal/slo$"), "get_slo"),
     ("GET", re.compile(r"^/internal/debug/bundles$"), "get_debug_bundles"),
     ("GET", re.compile(r"^/internal/debug/bundles/([^/]+)$"),
@@ -684,6 +687,21 @@ class Handler(BaseHTTPRequestHandler):
         from pilosa_tpu.obs import devprof
 
         self._send(200, devprof.stats_json())
+
+    def get_stats_stream(self):
+        svc = getattr(self.api, "stream", None)
+        self._send(200, svc.stats() if svc is not None else
+                   {"enabled": False})
+
+    def post_stream_push(self, index: str):
+        """Push records into the streaming ingest broker. Saturation
+        (device stages behind, backlog over limit) surfaces as 429 via
+        AdmissionError -> _dispatch, telling producers to back off."""
+        svc = getattr(self.api, "stream", None)
+        if svc is None or svc.index != index:
+            raise KeyError(f"no stream service on index {index!r}")
+        body = self._json_body()
+        self._send(200, svc.push(body.get("records") or []))
 
     def get_debug_bundles(self):
         hp = self._health_plane()

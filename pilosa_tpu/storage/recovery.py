@@ -60,6 +60,16 @@ CRASH_SITES = (
     "checkpoint.mid",
 )
 
+# pipeline stage-boundary kill sites (stream/pipeline.py). A SEPARATE
+# tuple: seeded() chooses over CRASH_SITES only, so the pinned crash-lane
+# seeds (tier1.sh seeds 1/7) keep selecting the same sites forever; the
+# stream lane draws from stream_seeded() instead.
+STREAM_CRASH_SITES = (
+    "stream.handoff",
+    "stream.apply",
+    "stream.commit",
+)
+
 CHECKPOINT_META = "checkpoint.json"
 
 
@@ -89,7 +99,7 @@ class CrashPlan:
         self._lock = threading.Lock()
 
     def kill(self, site: str, at: int = 1) -> "CrashPlan":
-        if site not in CRASH_SITES:
+        if site not in CRASH_SITES and site not in STREAM_CRASH_SITES:
             raise ValueError(f"unknown crash site {site!r}")
         if at < 1:
             raise ValueError("at must be >= 1")
@@ -102,6 +112,15 @@ class CrashPlan:
         crash, forever (string-seeded like FaultPlan/GossipAgent)."""
         rng = random.Random(f"crash:{seed}")
         return cls().kill(rng.choice(CRASH_SITES), at=rng.randint(1, 4))
+
+    @classmethod
+    def stream_seeded(cls, seed) -> "CrashPlan":
+        """Seed-derived plan over the pipeline stage boundaries — the
+        stream lane's analog of :meth:`seeded` (its own keyspace so the
+        storage lane's pinned seeds stay untouched)."""
+        rng = random.Random(f"stream-crash:{seed}")
+        return cls().kill(rng.choice(STREAM_CRASH_SITES),
+                          at=rng.randint(1, 3))
 
     @classmethod
     def from_env(cls, var: str = "PILOSA_TPU_CRASH_SEED") -> Optional["CrashPlan"]:
@@ -183,13 +202,22 @@ def abandon_holder(holder) -> None:
 # -- checkpoint LSN metadata -------------------------------------------------
 
 
-def write_checkpoint_meta(index_path: str, lsn: int) -> None:
+def write_checkpoint_meta(index_path: str, lsn: int,
+                          stream_offsets: Optional[Dict] = None) -> None:
     """Atomically persist the checkpoint LSN for one index: every WAL
-    record <= ``lsn`` is subsumed by the on-disk snapshots."""
+    record <= ``lsn`` is subsumed by the on-disk snapshots. When the
+    index carries stream consumer watermarks (stream/pipeline.py), they
+    are stamped alongside — the WAL ``stream_offsets`` records that fed
+    them may be pruned with the segments the checkpoint covers."""
     path = os.path.join(index_path, CHECKPOINT_META)
     tmp = path + ".tmp"
+    doc: Dict[str, Any] = {"lsn": int(lsn)}
+    if stream_offsets:
+        doc["stream_offsets"] = {
+            g: {k: int(v) for k, v in m.items()}
+            for g, m in stream_offsets.items()}
     with open(tmp, "w") as f:
-        json.dump({"lsn": int(lsn)}, f)
+        json.dump(doc, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -204,6 +232,22 @@ def read_checkpoint_meta(index_path: Optional[str]) -> int:
             return int(json.load(f).get("lsn", 0))
     except (OSError, ValueError):
         return 0
+
+
+def read_checkpoint_offsets(index_path: Optional[str]) -> Dict[str, Dict[str, int]]:
+    """The stream watermark stamp from ``checkpoint.json``:
+    ``{group: {"topic:partition": next_offset}}`` (empty on missing or
+    pre-stream metadata). ``read_checkpoint_meta`` keeps its plain-int
+    return for every existing caller."""
+    if not index_path:
+        return {}
+    try:
+        with open(os.path.join(index_path, CHECKPOINT_META)) as f:
+            raw = json.load(f).get("stream_offsets") or {}
+        return {str(g): {str(k): int(v) for k, v in m.items()}
+                for g, m in raw.items()}
+    except (OSError, ValueError, AttributeError):
+        return {}
 
 
 # -- record shard filtering (catch-up applies only owned shards) -------------

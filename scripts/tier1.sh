@@ -63,6 +63,18 @@ for seed in 1 7; do
         -p no:xdist -p no:randomly || exit $?
 done
 
+echo "== stream crash lane (PILOSA_TPU_CRASH_SEED=1 / 7) =="
+# Exactly-once streaming ingest must hold for ANY seeded kill point: the
+# seed draws a site/hit-count from the stream stage-boundary tuple
+# (handoff/apply/commit), disjoint from the storage sites so the lane
+# above is unchanged. test_recovery.py rides along to prove the storage
+# crash matrix still holds with the stream subsystem loaded.
+for seed in 1 7; do
+    PILOSA_TPU_CRASH_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_stream.py tests/test_recovery.py \
+        -q -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+done
+
 echo "== cluster-batch lane (PILOSA_TPU_CLUSTER_BATCH=1, fault seeds) =="
 # The cluster suites re-run with the per-node leg coalescer attached to
 # every node (the env flag ISSUE 9 ships): results must stay
@@ -141,6 +153,14 @@ echo "== devprof overhead bench gate (bench.py --configs 16) =="
 # results with PILOSA_TPU_DEVPROF=1, zero cost-model allocations when
 # disabled, and a profile with MFU/GB/s for every compiled family.
 JAX_PLATFORMS=cpu python bench.py --configs 16 || exit $?
+
+echo "== streaming ingest bench gate (bench.py --configs 17) =="
+# Hard-asserts the ISSUE 13 acceptance bar in-process: pipelined chunked
+# ingest >= 2x the classic c1 path on the same hardware, bit-identical
+# final state vs the classic-Ingester-over-broker oracle, and read
+# p50/p99 under concurrent full-rate ingest within 1.5x of the
+# no-ingest baseline (batch admission yields: writes shed, not reads).
+JAX_PLATFORMS=cpu python bench.py --configs 17 || exit $?
 
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver

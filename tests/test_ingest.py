@@ -101,6 +101,51 @@ def test_idalloc_commit_tail_survives_reload(tmp_path):
     assert b.reserve("t", 5).base == r.base + 10
 
 
+def test_idalloc_reserve_then_crash_replays_range(tmp_path):
+    # Crash between reserve and commit: the journal already has the
+    # reservation, so the retry (same session, same stream offset) must
+    # get the SAME range back — the idempotence the streaming pipeline's
+    # auto-id path leans on (stream/pipeline.py session naming).
+    path = str(tmp_path / "ids.jsonl")
+    a = IDAllocator(path)
+    r = a.reserve("g:t:0:0", 400, offset=0)
+    b = IDAllocator(path)  # crash: no commit ever journaled
+    again = b.reserve("g:t:0:0", 400, offset=0)
+    assert (again.base, again.count) == (r.base, r.count)
+    # a LATER stream position is a new reservation, past the first
+    nxt = b.reserve("g:t:0:400", 400, offset=1)
+    assert nxt.base >= r.end
+
+
+def test_idalloc_commit_then_crash_keeps_next_id(tmp_path):
+    # Crash after commit: the committed tail rollback is journaled, so
+    # the reloaded allocator neither reuses nor leaks the tail.
+    path = str(tmp_path / "ids.jsonl")
+    a = IDAllocator(path)
+    r = a.reserve("s", 1000, offset=0)
+    a.commit("s", count=250)
+    b = IDAllocator(path)
+    assert b.next_id == r.base + 250
+    assert b.reserve("t", 5, offset=0).base == r.base + 250
+
+
+def test_idalloc_interleaved_sessions_replay(tmp_path):
+    # Two live sessions interleaving reserves/commits; a crash replays
+    # the journal into the same allocation state.
+    path = str(tmp_path / "ids.jsonl")
+    a = IDAllocator(path)
+    r1 = a.reserve("s1", 100, offset=0)
+    r2 = a.reserve("s2", 50, offset=0)
+    assert r2.base == r1.end
+    a.commit("s2")  # commits in a different order than reserves
+    r3 = a.reserve("s1", 100, offset=1)  # s1 advances to its next batch
+    a.commit("s1")
+    b = IDAllocator(path)
+    assert b.next_id == a.next_id
+    # fresh work lands past everything either session touched
+    assert b.reserve("s3", 5, offset=0).base >= r3.end
+
+
 def test_csv_source_typed_header(api, tmp_path):
     p = tmp_path / "data.csv"
     p.write_text(

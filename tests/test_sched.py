@@ -198,6 +198,48 @@ class TestAdmission:
             pass
 
 
+class TestReadProtection:
+    """Batch-priority admits (streaming-ingest applies) yield whenever
+    interactive work is active: writes shed, reads keep the machine."""
+
+    def test_batch_admit_yields_to_interactive_ticket(self, make_sched):
+        clock = ManualClock()
+        s = make_sched(StubExecutor(), window_ms=0, clock=clock)
+        with s.admit():  # an interactive read is on the machine
+            with pytest.raises(AdmissionError):
+                with s.admit(priority=PRIORITY_BATCH):
+                    pass
+        # released, but the holdoff keeps batch work parked until reads
+        # have been quiet long enough
+        with pytest.raises(AdmissionError):
+            with s.admit(priority=PRIORITY_BATCH):
+                pass
+        clock.advance(1.0)
+        with s.admit(priority=PRIORITY_BATCH):
+            pass
+
+    def test_batch_admit_yields_to_queued_reads(self, make_sched):
+        s = make_sched(StubExecutor(), window_ms=0)
+        s.pause()
+        s.submit("i", "Count(Row(f=1))")
+        with pytest.raises(AdmissionError):
+            with s.admit(priority=PRIORITY_BATCH):
+                pass
+        s.resume()
+
+    def test_yield_rejections_are_counted(self, make_sched):
+        reg = MetricsRegistry()
+        clock = ManualClock()
+        s = make_sched(StubExecutor(), window_ms=0, clock=clock,
+                       registry=reg)
+        with s.admit():
+            with pytest.raises(AdmissionError):
+                with s.admit(priority=PRIORITY_BATCH):
+                    pass
+        assert reg.value(M.METRIC_SCHED_REJECTED, priority="batch",
+                         reason="interactive_busy") == 1
+
+
 class TestDeadlines:
     def test_expired_deadline_fails_without_poisoning_batch(self, make_sched):
         stub = StubExecutor()
