@@ -1532,6 +1532,213 @@ def bench_config17(device: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Config 18 — multi-tenant noisy-neighbor isolation (obs/tenants.py)
+# ---------------------------------------------------------------------------
+
+def bench_config18(device: str) -> None:
+    """Tenant-plane gate: noisy-neighbor isolation under chaos.
+
+    One 3-node LocalCluster (replica 2) under a seeded FaultPlan delay
+    plan, serving three well-behaved tenants and one abuser over real
+    HTTP with X-Tenant attribution.
+
+    1. plane off — the well-behaved read suite over HTTP: the results
+       oracle. HARD asserts: zero tenant context switches while
+       disabled (SCOPE_COUNT unchanged) — off means free.
+    2. plane on (quotas + fair share + health), no abuser — per-tenant
+       baseline p99. HARD asserts: results bit-identical to the oracle.
+    3. abuser on — "mallory" floods a separate index with queries (a
+       third of them erroring) and imports, capped by per-tenant
+       quotas. HARD asserts: every well-behaved tenant's p99 <= 1.5x
+       its no-abuser baseline, results STILL bit-identical, the abuser
+       was actually rejected (429 + Retry-After), the abuser is burning
+       its SLO error budget while no well-behaved tenant is,
+       /internal/tenants reports all four tenants, per-tenant burn
+       gauges landed in /metrics, and a tenant_burn flight bundle
+       captured the incident.
+    """
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.cluster.resilience import FaultPlan
+    from pilosa_tpu.obs import tenants as tenants_mod
+
+    rng = np.random.default_rng(18)
+    n = _n(400_000)
+    city = rng.integers(0, 50, n)
+    dev = rng.integers(0, 8, n)
+    wb = ("alpha", "bravo", "charlie")
+    suite = [
+        "GroupBy(Rows(city), Rows(device), limit=100)",
+        "Count(Intersect(Row(city=7), Row(device=3)))",
+        "TopN(city, n=5)",
+    ]
+    iters = max(10, QUERY_ITERS * 2)
+
+    plan = (FaultPlan(seed=18)
+            .delay("node1", 0.002, prob=0.2, op="query")
+            .delay("node2", 0.002, prob=0.2, op="query"))
+
+    with tempfile.TemporaryDirectory(prefix="bench18") as tmp, \
+            LocalCluster(3, replica_n=2, base_path=tmp,
+                         fault_plan=plan) as cluster:
+        coord = cluster.coordinator
+        uri = coord.node.uri
+
+        def req(path, data=None, tenant=None, method=None, ctype=None):
+            r = urllib.request.Request(uri + path, data=data,
+                                       method=method)
+            if tenant is not None:
+                r.add_header("X-Tenant", tenant)
+            if ctype is not None:
+                r.add_header("Content-Type", ctype)
+            try:
+                with urllib.request.urlopen(r, timeout=60) as resp:
+                    return (resp.status, _json.loads(resp.read()),
+                            dict(resp.headers))
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read()), dict(e.headers)
+
+        def run_suite(tenant):
+            results, times = [], []
+            for q in suite:
+                st, body, _ = req("/index/mt/query", q.encode(),
+                                  tenant)  # warm
+                assert st == 200, body
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    st, body, _ = req("/index/mt/query", q.encode(),
+                                      tenant)
+                    times.append(time.perf_counter() - t0)
+                    assert st == 200, body
+                results.append(body["results"])
+            return results, float(np.percentile(times, 99)) * 1e3
+
+        coord.create_index("mt")
+        coord.create_field("mt", "city", {"type": "set"})
+        coord.create_field("mt", "device", {"type": "set"})
+        cols = list(range(n))
+        coord.import_bits("mt", "city", rows=city.tolist(), cols=cols)
+        coord.import_bits("mt", "device", rows=dev.tolist(), cols=cols)
+
+        # phase 1: plane off — the oracle, and proof that off is free
+        scope0 = tenants_mod.SCOPE_COUNT
+        assert coord.tenants is None
+        oracle, _ = run_suite(None)
+        assert tenants_mod.SCOPE_COUNT == scope0, \
+            "tenant context touched while the plane is disabled"
+
+        # phase 2: plane on, no abuser — per-tenant baselines
+        regs = cluster.enable_tenants()
+        cluster.enable_health()
+        for node in cluster.nodes:
+            node.enable_scheduler()
+        # quota only binds the abuser; well-behaved tenants stay
+        # unlimited (rate 0) — attribution without enforcement
+        regs[0].set_quota("mallory", qps=5.0, ingest_rows_s=400.0)
+        baseline = {}
+        for t in wb:
+            res, baseline[t] = run_suite(t)
+            assert res == oracle, f"tenant {t} diverged with plane on"
+
+        # phase 3: the abuser saturates a SEPARATE index while the
+        # well-behaved tenants re-run their suites
+        coord.create_index("abuse")
+        coord.create_field("abuse", "f", {"type": "set"})
+        stop = threading.Event()
+        stats = {"attempts": 0, "rejected": 0, "retry_after": 0}
+        imp = _json.dumps({"field": "f", "rows": [1] * 200,
+                           "cols": list(range(200))}).encode()
+
+        def abuser():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                if k % 4 == 0:
+                    st, _, h = req("/index/abuse/import", imp, "mallory",
+                                   ctype="application/json")
+                elif k % 3 == 0:
+                    # SLO damage: a query that errors (missing field)
+                    st, _, h = req("/index/abuse/query",
+                                   b"Row(missing=1)", "mallory")
+                else:
+                    st, _, h = req("/index/abuse/query", b"Row(f=1)",
+                                   "mallory")
+                stats["attempts"] += 1
+                if st == 429:
+                    stats["rejected"] += 1
+                    if h.get("Retry-After"):
+                        stats["retry_after"] += 1
+                    # a shed request is nearly free server-side, but
+                    # un-paced urllib would turn the loop into a raw
+                    # connection flood (accept + thread per request) —
+                    # a layer below what tenant quotas meter. Pace like
+                    # a client that ignores most of the Retry-After.
+                    time.sleep(0.02)
+                else:
+                    time.sleep(0.005)
+
+        threads = [threading.Thread(target=abuser, daemon=True)
+                   for _ in range(2)]
+        for th in threads:
+            th.start()
+        while stats["attempts"] < 50:  # saturate before measuring
+            time.sleep(0.005)
+        busy = {}
+        for t in wb:
+            res, busy[t] = run_suite(t)
+            assert res == oracle, f"tenant {t} diverged under abuse"
+        # trigger evaluation rides timeline samples; force one while
+        # the burn state is hot
+        coord.health.timeline.sample()
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+
+        assert stats["rejected"] > 0, "abuser was never rejected"
+        assert stats["retry_after"] > 0, "429s carried no Retry-After"
+        for t in wb:
+            assert busy[t] <= 1.5 * baseline[t], \
+                f"tenant {t} p99 {busy[t]:.1f}ms vs " \
+                f"{baseline[t]:.1f}ms no-abuser baseline"
+        burn = coord.health.slo.tenant_burn_rates()
+        alerting = {r["tenant"] for r in burn if r["alerting"]}
+        assert "mallory" in alerting, \
+            f"abuser not burning (rows: {burn})"
+        assert not (alerting & set(wb)), \
+            f"well-behaved tenant burning: {alerting}"
+        st, tj, _ = req("/internal/tenants")
+        assert st == 200 and tj["enabled"]
+        seen = set(tj["tenants"])
+        assert set(wb) | {"mallory"} <= seen, seen
+        assert tj["tenants"]["mallory"]["rejected"] > 0
+        with urllib.request.urlopen(uri + "/metrics",
+                                    timeout=30) as resp:
+            prom = resp.read().decode()
+        assert 'slo_burn_rate{' in prom and 'tenant="mallory"' in prom, \
+            "per-tenant burn gauges missing from /metrics"
+        bundles = coord.health.flight.summaries()
+        assert any(b["trigger"] == "tenant_burn" for b in bundles), \
+            f"no tenant_burn flight bundle (got {bundles})"
+
+        for t in wb:
+            _emit(f"c18_wb_p99{{tenant={t}}}{SCALED} ({device})",
+                  busy[t], "ms", baseline[t] / busy[t],
+                  baseline_p99_ms=baseline[t])
+        worst = max(wb, key=lambda t: busy[t] / baseline[t])
+        _emit(f"c18_noisy_neighbor_wb_p99{SCALED} ({device})",
+              busy[worst], "ms", baseline[worst] / busy[worst],
+              baseline_p99_ms=baseline[worst],
+              abuser_attempts=stats["attempts"],
+              abuser_rejected=stats["rejected"],
+              tenants_tracked=tj["tracked"])
+
+
+# ---------------------------------------------------------------------------
 # Config 3 — TopK + GroupBy at SSB SF-1 scale (headline, printed last)
 # ---------------------------------------------------------------------------
 
@@ -1689,6 +1896,7 @@ _CONFIGS = {
     "15": bench_config15,
     "16": bench_config16,
     "17": bench_config17,
+    "18": bench_config18,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

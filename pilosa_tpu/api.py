@@ -20,6 +20,7 @@ from pilosa_tpu.core.schema import FieldOptions, FieldType, IndexOptions
 from pilosa_tpu.pql.executor import Executor
 from pilosa_tpu.obs import ExecutionRequestsAPI, get_tracer
 from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.obs.tenants import current_tenant_id
 from pilosa_tpu.pql.result import result_to_json
 from pilosa_tpu.storage import save_holder_data
 from pilosa_tpu.storage.txn import TxFactory
@@ -64,6 +65,10 @@ class API:
         # topic + pipelined exactly-once ingester. None = off; enabled
         # via enable_stream (config [stream] / PILOSA_TPU_STREAM_*).
         self.stream = None
+        # optional tenant attribution plane (obs/tenants.py): per-tenant
+        # usage accounting, quotas, fair-share weights. None = off and
+        # the request paths pay one attribute check.
+        self.tenants = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
             self.holder.recover()
@@ -77,6 +82,10 @@ class API:
                 interval_ms=float(_os.environ.get(
                     "PILOSA_TPU_OBS_TIMELINE_INTERVAL_MS", "1000")),
                 start=False)
+        if env_bool("PILOSA_TPU_TENANTS"):
+            # attribution-only defaults (quotas 0 = unlimited): safe to
+            # run the whole suite under, like the timeline env gate
+            self.enable_tenants()
 
     def set_query_logger(self, path: str) -> None:
         from pilosa_tpu.obs.logger import QueryLogger
@@ -101,6 +110,7 @@ class API:
                 self.executor, config, **overrides)
         else:
             self.scheduler = QueryScheduler(self.executor, **overrides)
+        self._wire_tenants()
         return self.scheduler
 
     def disable_scheduler(self) -> None:
@@ -130,6 +140,7 @@ class API:
 
         self.cache = ResultCache.from_config(config, **overrides)
         self.executor.cache = self.cache
+        self._wire_tenants()
         return self.cache
 
     def disable_cache(self) -> None:
@@ -195,6 +206,61 @@ class API:
         if svc is not None:
             svc.close()
 
+    # -- tenant plane (obs/tenants.py: attribution + quotas + fair share) --
+
+    def enable_tenants(self, config=None, **overrides):
+        """Attach the tenant attribution plane: per-tenant usage counters
+        (queries, rows, device-seconds, cache traffic, WAL bytes),
+        token-bucket quotas (QuotaExceededError -> 429 + Retry-After when
+        exhausted; rate 0 = unlimited, attribution without enforcement),
+        weighted fair-share scheduler ordering, and tenant-scoped cache
+        namespaces. ``config`` is a pilosa_tpu.config.Config ([tenants]);
+        kwargs override TenantRegistry knobs (max_tracked, top_k,
+        default_qps, default_ingest_rows_s, cache_quota_bytes, clock,
+        registry). Compose with devprof by enabling the tenant plane
+        LAST: its device-seconds hook chains whatever is installed, but
+        a later devprof.enable() replaces the platform hook pair."""
+        from pilosa_tpu.obs.tenants import TenantRegistry
+
+        if self.tenants is not None:
+            self.disable_tenants()
+        self._tenants_fair = (True if config is None
+                              else bool(config.tenants_fair_share))
+        reg = self.tenants = TenantRegistry.from_config(config, **overrides)
+        reg.install_hooks()
+        self._wire_tenants()
+        return reg
+
+    def _wire_tenants(self) -> None:
+        """Wire the tenant plane into whichever optional planes exist
+        right now; enable_cache/enable_scheduler call this again so
+        enable order doesn't matter."""
+        reg = self.tenants
+        if reg is None:
+            return
+        self.executor.tenant_namespaces = True
+        if self.cache is not None:
+            self.cache.tenant_hook = reg.cache_hook
+            self.cache.tenant_of = current_tenant_id
+            self.cache.tenant_quota_bytes = reg.cache_quota_bytes
+        if self.scheduler is not None and getattr(self, "_tenants_fair",
+                                                  True):
+            self.scheduler.set_fair_share(True, reg.weight)
+
+    def disable_tenants(self) -> None:
+        reg, self.tenants = self.tenants, None
+        if reg is None:
+            return
+        reg.uninstall_hooks()
+        reg.close()
+        self.executor.tenant_namespaces = False
+        if self.cache is not None:
+            self.cache.tenant_hook = None
+            self.cache.tenant_of = None
+            self.cache.tenant_quota_bytes = 0
+        if self.scheduler is not None:
+            self.scheduler.set_fair_share(False)
+
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
     def create_index(self, name: str, options: Optional[dict] = None) -> Index:
@@ -256,6 +322,9 @@ class API:
         span = get_tracer().start_trace("query.pql", index=index)
         rec.trace_id = span.trace_id
         span.set_tag("request_id", rec.request_id)
+        tenant = current_tenant_id() if self.tenants is not None else None
+        if tenant is not None:
+            span.set_tag("tenant", tenant)
         t0 = _time.monotonic()
         try:
             parsed = parse(pql) if isinstance(pql, str) else pql
@@ -282,7 +351,10 @@ class API:
                 self.query_logger.log("pql", index, text,
                                       _time.monotonic() - t0)
             if self.health is not None:
-                self.health.record("query", _time.monotonic() - t0)
+                self.health.record("query", _time.monotonic() - t0,
+                                   tenant=tenant)
+            if self.tenants is not None:
+                self.tenants.note_query(tenant)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
@@ -291,7 +363,9 @@ class API:
                                       _time.monotonic() - t0, error=str(e))
             if self.health is not None:
                 self.health.record("query", _time.monotonic() - t0,
-                                   error=True)
+                                   error=True, tenant=tenant)
+            if self.tenants is not None:
+                self.tenants.note_query(tenant, error=True)
             raise
         finally:
             span.finish()
@@ -314,6 +388,9 @@ class API:
         span = get_tracer().start_trace("query.sql")
         rec.trace_id = span.trace_id
         span.set_tag("request_id", rec.request_id)
+        tenant = current_tenant_id() if self.tenants is not None else None
+        if tenant is not None:
+            span.set_tag("tenant", tenant)
         t0 = _time.monotonic()
         try:
             out = eng.query(query, parsed=parsed)
@@ -322,7 +399,10 @@ class API:
                 self.query_logger.log("sql", "", query,
                                       _time.monotonic() - t0)
             if self.health is not None:
-                self.health.record("sql", _time.monotonic() - t0)
+                self.health.record("sql", _time.monotonic() - t0,
+                                   tenant=tenant)
+            if self.tenants is not None:
+                self.tenants.note_query(tenant)
             return out
         except Exception as e:
             self.history.end(rec, error=str(e))
@@ -331,7 +411,9 @@ class API:
                                       _time.monotonic() - t0, error=str(e))
             if self.health is not None:
                 self.health.record("sql", _time.monotonic() - t0,
-                                   error=True)
+                                   error=True, tenant=tenant)
+            if self.tenants is not None:
+                self.tenants.note_query(tenant, error=True)
             raise
         finally:
             span.finish()
@@ -349,15 +431,23 @@ class API:
 
         @contextlib.contextmanager
         def scope():
+            t = (current_tenant_id() if self.tenants is not None
+                 else None)
             t0 = _time.monotonic()
             try:
                 yield
             except Exception:
-                hp.record("ingest", _time.monotonic() - t0, error=True)
+                hp.record("ingest", _time.monotonic() - t0, error=True,
+                          tenant=t)
                 raise
-            hp.record("ingest", _time.monotonic() - t0)
+            hp.record("ingest", _time.monotonic() - t0, tenant=t)
 
         return scope()
+
+    def _note_tenant_rows(self, rows: int) -> None:
+        """Per-tenant ingest accounting for the bulk-import surface."""
+        if self.tenants is not None and rows:
+            self.tenants.note(current_tenant_id(), rows=rows)
 
     def _maybe_slow_log(self, kind: str, index: str, text: str,
                         duration_s: float, rec) -> None:
@@ -418,6 +508,7 @@ class API:
                     np.zeros(len(cols), dtype=np.int64), cols)
         M.REGISTRY.count(M.METRIC_CLEARED if clear else M.METRIC_IMPORTED,
                          len(cols))
+        self._note_tenant_rows(len(cols))
         self._update_shard_gauge(idx)
         return changed
 
@@ -444,6 +535,7 @@ class API:
                 idx.field("_exists").import_bits(
                     np.zeros(len(cols), dtype=np.int64), cols)
         M.REGISTRY.count(M.METRIC_IMPORTED, len(cols))
+        self._note_tenant_rows(len(cols))
         self._update_shard_gauge(idx)
         return len(cols)
 
@@ -467,10 +559,12 @@ class API:
                 f"field {field!r} is int-like; roaring imports target "
                 "bitmap-row fields")
         all_cols: set = set()
+        total_bits = 0
         with self.txf.qcx():
             for view, blob in views.items():
                 view = view or timeq.VIEW_STANDARD
                 positions = decode_to_positions(blob)
+                total_bits += int(positions.size)
                 rows = (positions >> np.uint64(SHARD_WIDTH_EXP)).astype(np.int64)
                 cols = (positions & np.uint64(SHARD_WIDTH - 1)).astype(np.int64)
                 for row in np.unique(rows):
@@ -485,6 +579,7 @@ class API:
                 base = shard * SHARD_WIDTH
                 idx.field("_exists").import_bits(
                     [0] * len(all_cols), [base + c for c in sorted(all_cols)])
+        self._note_tenant_rows(total_bits)
 
     def _update_shard_gauge(self, idx: Index) -> None:
         M.REGISTRY.gauge(M.METRIC_MAX_SHARD, max(idx.shards(), default=0),

@@ -83,6 +83,7 @@ class _Entry:
     value: Any
     cost: int
     expires_at: float  # monotonic deadline; inf = no TTL
+    tenant: Optional[str] = None  # inserting tenant (resident quota)
 
 
 class ResultCache:
@@ -111,6 +112,14 @@ class ResultCache:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        # tenant attribution (api.enable_tenants): hook(kind, n) fires
+        # ("hit", 1) per hit and ("bytes", cost) per insert; tenant_of
+        # (-> current tenant or None) stamps entries so the per-tenant
+        # resident-byte quota can bound one tenant's share of the cache
+        self.tenant_hook = None
+        self.tenant_of = None
+        self.tenant_quota_bytes = 0
+        self._tenant_bytes: Dict[str, int] = {}
 
     @classmethod
     def from_config(cls, config=None, **overrides) -> "ResultCache":
@@ -139,6 +148,8 @@ class ResultCache:
             self.registry.observe_bucketed(
                 M.METRIC_CACHE_HIT_LATENCY, time.perf_counter() - t0,
                 M.CACHE_LATENCY_BUCKETS)
+            if self.tenant_hook is not None:
+                self.tenant_hook("hit", 1)
             active_span().record("cache.lookup", time.perf_counter() - t0,
                                  outcome="hit")
             return True, value
@@ -179,6 +190,8 @@ class ResultCache:
             self.registry.observe_bucketed(
                 M.METRIC_CACHE_HIT_LATENCY, time.perf_counter() - t0,
                 M.CACHE_LATENCY_BUCKETS)
+            if self.tenant_hook is not None:
+                self.tenant_hook("hit", 1)
         elif outcome[0] == "leader":
             self._misses += 1
             self.registry.count(M.METRIC_CACHE_MISSES)
@@ -208,20 +221,36 @@ class ResultCache:
         cost = estimate_cost(value)
         if cost > self.max_bytes:
             return  # would evict the whole cache for one entry
+        tenant = self.tenant_of() if self.tenant_of is not None else None
         expires = (self.clock() + self.ttl_ms / 1000.0
                    if self.ttl_ms > 0 else float("inf"))
         stored = copy.deepcopy(value)
         with self._lock:
+            if (tenant is not None and self.tenant_quota_bytes > 0
+                    and self._tenant_bytes.get(tenant, 0) + cost
+                    > self.tenant_quota_bytes
+                    and key not in self._entries):
+                # over-quota tenants recompute instead of displacing the
+                # others' working set; serving stays correct, just uncached
+                self.registry.count(M.METRIC_TENANT_REJECTED,
+                                    tenant=tenant, kind="cache")
+                return
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.cost
-            self._entries[key] = _Entry(stored, cost, expires)
+                self._tenant_credit_locked(old)
+            self._entries[key] = _Entry(stored, cost, expires, tenant)
             self._bytes += cost
+            if tenant is not None:
+                self._tenant_bytes[tenant] = \
+                    self._tenant_bytes.get(tenant, 0) + cost
             while len(self._entries) > self.max_entries:
                 self._evict_locked("entries")
             while self._bytes > self.max_bytes and self._entries:
                 self._evict_locked("bytes")
             self._update_gauges_locked()
+        if self.tenant_hook is not None:
+            self.tenant_hook("bytes", cost)
 
     def run(self, key: Tuple, compute: Callable[[], Any]) -> Any:
         """Hit → cached copy. Miss as leader → compute (timed into the
@@ -264,6 +293,7 @@ class ResultCache:
             n = len(self._entries)
             self._entries.clear()
             self._bytes = 0
+            self._tenant_bytes.clear()
             self._update_gauges_locked()
         if n:
             self._evictions += n
@@ -304,6 +334,7 @@ class ResultCache:
         if e.expires_at <= self.clock():
             del self._entries[key]
             self._bytes -= e.cost
+            self._tenant_credit_locked(e)
             self._evictions += 1
             self.registry.count(M.METRIC_CACHE_EVICTIONS, reason="ttl")
             self._update_gauges_locked()
@@ -314,8 +345,18 @@ class ResultCache:
     def _evict_locked(self, reason: str) -> None:
         _, e = self._entries.popitem(last=False)
         self._bytes -= e.cost
+        self._tenant_credit_locked(e)
         self._evictions += 1
         self.registry.count(M.METRIC_CACHE_EVICTIONS, reason=reason)
+
+    def _tenant_credit_locked(self, e: _Entry) -> None:
+        if e.tenant is None:
+            return
+        left = self._tenant_bytes.get(e.tenant, 0) - e.cost
+        if left > 0:
+            self._tenant_bytes[e.tenant] = left
+        else:
+            self._tenant_bytes.pop(e.tenant, None)
 
     def _update_gauges_locked(self) -> None:
         self.registry.gauge(M.METRIC_CACHE_ENTRIES, len(self._entries))

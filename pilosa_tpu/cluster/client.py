@@ -32,6 +32,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence
 from urllib.parse import urlsplit
 
+from pilosa_tpu.obs.tenants import current_tenant_id
 from pilosa_tpu.obs.tracing import active_span, current_traceparent
 
 
@@ -173,6 +174,11 @@ class InternalClient:
                 headers["traceparent"] = tp
                 if attempt:
                     headers["x-trace-attempt"] = str(attempt)
+            # tenant context rides internal RPCs the same way, so fan-out
+            # legs and forwarded writes attribute to the original tenant
+            tenant = current_tenant_id()
+            if tenant is not None:
+                headers["x-tenant"] = tenant
             try:
                 if self.fault_plan is not None and node_id is not None:
                     if self.self_id is not None:

@@ -86,6 +86,13 @@ class LocalCluster:
         ``clock=``). Returns the planes in node order."""
         return [node.enable_health(**kw) for node in self.nodes]
 
+    def enable_tenants(self, **kw) -> list:
+        """Enable the tenant attribution plane on every node
+        (ClusterNode.enable_tenants kwargs pass through — tests usually
+        share one ManualClock via ``clock=``). Returns the registries in
+        node order."""
+        return [node.enable_tenants(**kw) for node in self.nodes]
+
     def enable_membership(self, **kw) -> list:
         """Enable SWIM membership on every node (ClusterNode.enable_
         membership kwargs pass through; gossip auto-enables). Tests
@@ -159,6 +166,14 @@ class LocalCluster:
         for node in self.nodes:
             try:
                 node.disable_gossip()
+            except Exception:
+                pass
+        # uninstall in reverse enable order: each registry's process-wide
+        # WAL/platform hooks restore the previous link in the chain
+        for node in reversed(self.nodes):
+            try:
+                if node.tenants is not None:
+                    node.disable_tenants()
             except Exception:
                 pass
         for srv in self._servers:

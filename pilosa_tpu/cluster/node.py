@@ -92,6 +92,9 @@ class ClusterNode:
         # its probes to this node's live subsystems.
         if self.api.health is not None:
             self.api.health.attach_node(self)
+        # likewise the env-bootstrapped tenant plane (PILOSA_TPU_TENANTS=1)
+        # needs wiring into the cluster-side executor
+        self._wire_node_tenants()
 
     # -- topology ----------------------------------------------------------
 
@@ -227,17 +230,30 @@ class ClusterNode:
               priority: Optional[str] = None,
               deadline_ms: Optional[float] = None) -> List[Any]:
         hp = self.api.health
-        if hp is None:
+        reg = self.api.tenants
+        if hp is None and reg is None:
             return self._query_impl(index, pql, shards, priority,
                                     deadline_ms)
+        tenant = None
+        if reg is not None:
+            from pilosa_tpu.obs.tenants import current_tenant_id
+
+            tenant = current_tenant_id()
         t0 = time.monotonic()
         try:
             out = self._query_impl(index, pql, shards, priority,
                                    deadline_ms)
         except Exception:
-            hp.record("query", time.monotonic() - t0, error=True)
+            if hp is not None:
+                hp.record("query", time.monotonic() - t0, error=True,
+                          tenant=tenant)
+            if reg is not None:
+                reg.note_query(tenant, error=True)
             raise
-        hp.record("query", time.monotonic() - t0)
+        if hp is not None:
+            hp.record("query", time.monotonic() - t0, tenant=tenant)
+        if reg is not None:
+            reg.note_query(tenant)
         return out
 
     def _query_impl(self, index: str, pql: str,
@@ -307,6 +323,7 @@ class ClusterNode:
         else:
             sched = QueryScheduler(self.executor.local, **overrides)
         self.executor.scheduler = sched
+        self._wire_node_tenants()
         return sched
 
     def disable_scheduler(self) -> None:
@@ -334,11 +351,57 @@ class ClusterNode:
             warn_remote_ttl_deprecated()
         self.executor.cache = cache
         self.executor.local.cache = cache
+        self._wire_node_tenants()
         return cache
 
     def disable_cache(self) -> None:
         self.executor.cache = None
         self.executor.local.cache = None
+
+    # -- tenant plane (obs/tenants.py): same surface as the plain API ------
+
+    @property
+    def tenants(self):
+        return self.api.tenants
+
+    def enable_tenants(self, config=None, **overrides):
+        """Attach the tenant attribution plane (see API.enable_tenants)
+        and wire it into the node's cluster-side executor — the fan-out
+        cache and scheduler hang off ClusterExecutor, not the base API."""
+        reg = self.api.enable_tenants(config, **overrides)
+        self._wire_node_tenants()
+        return reg
+
+    def disable_tenants(self) -> None:
+        self.api.disable_tenants()
+        self.executor.local.tenant_namespaces = False
+        cache = self.executor.cache
+        if cache is not None:
+            cache.tenant_hook = None
+            cache.tenant_of = None
+            cache.tenant_quota_bytes = 0
+        if self.executor.scheduler is not None:
+            self.executor.scheduler.set_fair_share(False)
+
+    def _wire_node_tenants(self) -> None:
+        """Wire the tenant plane into whichever node-level planes exist
+        right now; enable_cache/enable_scheduler call this again so
+        enable order doesn't matter (mirrors API._wire_tenants, which
+        only knows the base API's executor)."""
+        reg = self.api.tenants
+        if reg is None:
+            return
+        from pilosa_tpu.obs.tenants import current_tenant_id
+
+        self.executor.local.tenant_namespaces = True
+        cache = self.executor.cache
+        if cache is not None:
+            cache.tenant_hook = reg.cache_hook
+            cache.tenant_of = current_tenant_id
+            cache.tenant_quota_bytes = reg.cache_quota_bytes
+        sched = self.executor.scheduler
+        if sched is not None and getattr(self.api, "_tenants_fair", True):
+            sched.set_fair_share(True, reg.weight)
 
     # -- fan-out resilience (cluster/resilience.py) ------------------------
 

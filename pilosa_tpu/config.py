@@ -233,6 +233,19 @@ class Config:
     stream_group: str = "ingest"
     stream_max_backlog_rows: int = 0
     stream_ingest_stall_s: float = 5.0
+    # tenant attribution plane ([tenants] section / PILOSA_TPU_TENANTS_*):
+    # bounded per-tenant accounting, tenant-scoped SLOs, token-bucket
+    # quotas and weighted-fair admission (obs/tenants.py; attach via
+    # API.enable_tenants, or set PILOSA_TPU_TENANTS=1 to auto-attach).
+    # Default quotas of 0 mean unlimited — attribution without
+    # enforcement until an operator opts a rate in.
+    tenants_enabled: bool = False
+    tenants_max_tracked: int = 64  # distinct tenant stat rows
+    tenants_top_k: int = 8  # label guard on tenant_* gauges
+    tenants_default_qps: float = 0.0  # queries/s per tenant; 0 = off
+    tenants_default_ingest_rows_s: float = 0.0  # rows/s per tenant
+    tenants_cache_quota_bytes: int = 0  # resident cache bytes per tenant
+    tenants_fair_share: bool = True  # weighted-fair admission ordering
 
     # -- sources -----------------------------------------------------------
 

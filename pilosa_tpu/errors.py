@@ -19,3 +19,13 @@ class AdmissionError(RuntimeError):
 class QueryDeadlineError(RuntimeError):
     """Query missed its deadline (or was cancelled) while queued.
     Maps to HTTP 408."""
+
+
+class QuotaExceededError(AdmissionError):
+    """A tenant exhausted one of its token-bucket quotas (QPS, ingest
+    rows/s). Subclasses AdmissionError so it rides the existing 429
+    mapping; ``retry_after_s`` is surfaced as a Retry-After header."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s

@@ -117,6 +117,19 @@ class FlightRecorder:
                     sample)
                 if b:
                     fired.append(b)
+            # per-tenant budget burn: names the burning tenant so the
+            # bundle answers "who" as well as "what" (returns [] with
+            # zero bucket work when no tenant-tagged event exists)
+            t_alert = plane.slo.tenant_alerting(sample.get("t"))
+            if t_alert:
+                who = ",".join(sorted({r["tenant"] for r in t_alert}))
+                burns = max(r["fast_burn"] for r in t_alert)
+                b = self.trigger(
+                    "tenant_burn",
+                    f"tenant {who} fast burn {burns:.1f}x budget",
+                    sample)
+                if b:
+                    fired.append(b)
 
         breakers = probes.get("breakers")
         if isinstance(breakers, dict):

@@ -70,6 +70,13 @@ def _stream_probe(owner):
     return svc.stats()
 
 
+def _tenants_probe(owner):
+    reg = getattr(owner, "tenants", None)
+    if reg is None:
+        return {"enabled": False}
+    return reg.timeline_probe()
+
+
 class HealthPlane:
     """Timeline sampler + SLO tracker + flight recorder, wired."""
 
@@ -155,6 +162,9 @@ class HealthPlane:
         # kernel profiles ride every timeline sample, so flight-recorder
         # bundles capture MFU/roofline state at anomaly time
         self.timeline.add_probe("kernels", devprof.timeline_probe)
+        # per-tenant top-K rates ride the samples too, so flight bundles
+        # capture WHICH tenant was burning during an anomaly
+        self.timeline.add_probe("tenants", lambda: _tenants_probe(api))
 
     def attach_node(self, node) -> None:
         """Upgrade probes to the cluster node's live subsystems (the
@@ -201,10 +211,11 @@ class HealthPlane:
     # -- request accounting ------------------------------------------------
 
     def record(self, surface: str, latency_s: float,
-               error: bool = False) -> None:
+               error: bool = False, tenant=None) -> None:
         """One request outcome into the SLO tracker; when no sampler
         thread runs, also the piggyback cadence check."""
-        self.slo.record(surface, latency_s * 1e3, error=error)
+        self.slo.record(surface, latency_s * 1e3, error=error,
+                        tenant=tenant)
         if not self.timeline.running:
             self.timeline.maybe_sample()
 

@@ -218,6 +218,21 @@ METRIC_STREAM_CREDITS = "stream_pipeline_credits"
 METRIC_STREAM_LAG = "stream_consumer_lag"
 METRIC_STREAM_SHED = "stream_ingest_shed_total"
 METRIC_STREAM_REJECTED = "stream_push_rejected_total"
+# tenant attribution plane (obs/tenants.py): per-tenant consumption
+# counters published as gauges by the bounded registry (a top-K label
+# guard keeps the label space finite no matter how many tenant IDs
+# arrive), quota rejections, and the unattributed-request counter that
+# satellite 3's never-a-400 clamping contract feeds
+METRIC_TENANT_QUERIES = "tenant_queries_total"
+METRIC_TENANT_ERRORS = "tenant_errors_total"
+METRIC_TENANT_REJECTED = "tenant_rejected_total"
+METRIC_TENANT_ROWS = "tenant_rows_ingested_total"
+METRIC_TENANT_DEVICE_SECONDS = "tenant_device_seconds_total"
+METRIC_TENANT_CACHE_HITS = "tenant_cache_hits_total"
+METRIC_TENANT_CACHE_BYTES = "tenant_cache_bytes_total"
+METRIC_TENANT_WAL_BYTES = "tenant_wal_bytes_total"
+METRIC_TENANT_UNATTRIBUTED = "tenant_unattributed_total"
+METRIC_TENANT_TRACKED = "tenant_tracked"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

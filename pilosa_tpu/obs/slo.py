@@ -87,25 +87,45 @@ class SLOTracker:
         for o in self.objectives:
             if o.kind == "latency":
                 self._lat_objs.setdefault(o.surface, []).append(o)
+        # tenant dimension: bounded set of tenant IDs ever recorded —
+        # past the cap new tenants fold into one overflow cell so a
+        # hostile ID stream can't grow the evaluation (or gauge labels)
+        self.tenant_cap = 32
+        self._tenant_ids: set = set()
 
     # -- recording ---------------------------------------------------------
 
+    def _accumulate(self, cell: dict, surface: str, latency_ms: float,
+                    error: bool) -> None:
+        cell["total"] += 1
+        if error:
+            cell["errors"] += 1
+        else:
+            for o in self._lat_objs.get(surface, ()):
+                if latency_ms > o.threshold_ms:
+                    cell["bad"][o.name] = cell["bad"].get(o.name, 0) + 1
+
     def record(self, surface: str, latency_ms: float,
-               error: bool = False) -> None:
+               error: bool = False, tenant: Optional[str] = None) -> None:
         now = self.clock.now()
         start = (now // self.bucket_s) * self.bucket_s
         with self._lock:
             if not self._buckets or self._buckets[-1]["t"] != start:
                 self._buckets.append({"t": start, "surfaces": {}})
-            cell = self._buckets[-1]["surfaces"].setdefault(
+            bucket = self._buckets[-1]
+            cell = bucket["surfaces"].setdefault(
                 surface, {"total": 0, "errors": 0, "bad": {}})
-            cell["total"] += 1
-            if error:
-                cell["errors"] += 1
-            else:
-                for o in self._lat_objs.get(surface, ()):
-                    if latency_ms > o.threshold_ms:
-                        cell["bad"][o.name] = cell["bad"].get(o.name, 0) + 1
+            self._accumulate(cell, surface, latency_ms, error)
+            if tenant is None:
+                return
+            if tenant not in self._tenant_ids:
+                if len(self._tenant_ids) >= self.tenant_cap:
+                    tenant = "__other__"
+                self._tenant_ids.add(tenant)
+            tcell = bucket.setdefault("tenants", {}).setdefault(
+                tenant, {}).setdefault(
+                    surface, {"total": 0, "errors": 0, "bad": {}})
+            self._accumulate(tcell, surface, latency_ms, error)
 
     # -- evaluation --------------------------------------------------------
 
@@ -178,12 +198,84 @@ class SLOTracker:
         not page anyone)."""
         return [r for r in self.burn_rates(now) if r["alerting"]]
 
+    # -- tenant dimension --------------------------------------------------
+
+    def _tenant_window(self, window_s: float,
+                       now: float) -> Dict[tuple, dict]:
+        """(tenant, surface) -> counts over the window (locked callers
+        only)."""
+        cutoff = now - window_s
+        agg: Dict[tuple, dict] = {}
+        for b in self._buckets:
+            if b["t"] + self.bucket_s <= cutoff:
+                continue
+            for tenant, surfaces in b.get("tenants", {}).items():
+                for surface, cell in surfaces.items():
+                    a = agg.setdefault((tenant, surface),
+                                       {"total": 0, "errors": 0, "bad": {}})
+                    a["total"] += cell["total"]
+                    a["errors"] += cell["errors"]
+                    for name, n in cell["bad"].items():
+                        a["bad"][name] = a["bad"].get(name, 0) + n
+        return agg
+
+    def tenant_burn_rates(self, now: Optional[float] = None) -> List[dict]:
+        """Per-(tenant, objective) burn over both windows, published as
+        ``slo_burn_rate{slo=,tenant=,window=}`` gauges. Returns []
+        without touching the buckets when no tenant-tagged event was
+        ever recorded — the plane-off path stays free."""
+        if now is None:
+            now = self.clock.now()
+        out: List[dict] = []
+        empty = {"total": 0, "errors": 0, "bad": {}}
+        with self._lock:
+            if not self._tenant_ids:
+                return out
+            fast = self._tenant_window(self.fast_window_s, now)
+            slow = self._tenant_window(self.slow_window_s, now)
+            # union of both windows: a tenant quiet for the last few
+            # minutes must still report (and decay) its slow burn
+            for tenant, surface in sorted(set(fast) | set(slow)):
+                c_fast = fast.get((tenant, surface), empty)
+                c_slow = slow.get((tenant, surface), empty)
+                for o in self.objectives:
+                    if o.surface != surface:
+                        continue
+                    fb = self._burn(o, c_fast)
+                    out.append({
+                        "tenant": tenant, "name": o.name,
+                        "surface": surface, "kind": o.kind,
+                        "fast_burn": fb,
+                        "slow_burn": self._burn(o, c_slow),
+                        "events_fast": c_fast["total"],
+                        "events_slow": c_slow["total"],
+                        "alerting": (fb >= self.fast_burn_alert
+                                     and c_fast["total"] >= self.min_events),
+                    })
+        for row in out:
+            self.registry.gauge(obs_metrics.METRIC_SLO_BURN_RATE,
+                                row["fast_burn"], slo=row["name"],
+                                tenant=row["tenant"], window="fast")
+            self.registry.gauge(obs_metrics.METRIC_SLO_BURN_RATE,
+                                row["slow_burn"], slo=row["name"],
+                                tenant=row["tenant"], window="slow")
+        return out
+
+    def tenant_alerting(self, now: Optional[float] = None) -> List[dict]:
+        """Tenant rows whose fast burn crossed the alert threshold —
+        the ``tenant_burn`` flight-recorder trigger's input."""
+        return [r for r in self.tenant_burn_rates(now) if r["alerting"]]
+
     def status(self, now: Optional[float] = None) -> dict:
         rows = self.burn_rates(now)
-        return {
+        out = {
             "fast_window_s": self.fast_window_s,
             "slow_window_s": self.slow_window_s,
             "fast_burn_alert": self.fast_burn_alert,
             "objectives": rows,
             "alerting": [r["name"] for r in rows if r["alerting"]],
         }
+        trows = self.tenant_burn_rates(now)
+        if trows:
+            out["tenants"] = trows
+        return out

@@ -215,6 +215,10 @@ class Executor:
         # result cache (cache/), attached by api.enable_cache(). None
         # keeps the read path byte-identical to the uncached build.
         self.cache = None
+        # tenant-scoped cache namespaces (api.enable_tenants): each
+        # tenant's results key under its own namespace so one tenant
+        # can't evict — or observe timing of — another's working set
+        self.tenant_namespaces = False
 
     # -- public entry (reference: executor.go:183 Execute) --------------------
 
@@ -255,7 +259,19 @@ class Executor:
             return None
         return query_cache_key(
             idx, query, self._shards(idx, shards),
-            namespace="remote" if self.remote else "local")
+            namespace=self._namespace())
+
+    def _namespace(self) -> str:
+        """Cache-key namespace: the result dialect (local/remote), plus
+        the current tenant when tenant-scoped namespaces are on."""
+        ns = "remote" if self.remote else "local"
+        if self.tenant_namespaces:
+            from pilosa_tpu.obs.tenants import current_tenant_id
+
+            t = current_tenant_id()
+            if t is not None:
+                return f"{ns}|{t}"
+        return ns
 
     def _execute_read(self, idx: Index, query: Query, shards) -> List[Any]:
         from pilosa_tpu.core.stacked import StackStale
@@ -386,7 +402,7 @@ class Executor:
             key_lists = [shared] * len(qs)
         else:
             key_lists = shard_lists
-        ns = "remote" if self.remote else "local"
+        ns = self._namespace()
         results: List[Optional[List[Any]]] = [None] * len(qs)
         to_run: List[Tuple[int, Optional[Tuple]]] = []  # (slot, key|None)
         followers = []  # (slot, future)

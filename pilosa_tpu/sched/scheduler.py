@@ -20,6 +20,8 @@ from typing import List, Optional, Sequence, Union
 
 from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
 from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.obs.tenants import (DEFAULT_TENANT, current_tenant_id,
+                                    tenant_scope)
 from pilosa_tpu.obs.tracing import active_span
 from pilosa_tpu.pql.ast import Call, Query
 from pilosa_tpu.pql.executor import has_write_calls, query_maskable
@@ -36,7 +38,8 @@ _PRIORITY_RANK = {PRIORITY_INTERACTIVE: 0, PRIORITY_BATCH: 1}
 
 class _Pending:
     __slots__ = ("index", "query", "shards", "priority", "rank", "deadline",
-                 "future", "enqueued", "seq", "key", "fusible", "span")
+                 "future", "enqueued", "seq", "key", "fusible", "span",
+                 "tenant", "vtime")
 
     def __init__(self, index: str, query: Query,
                  shards: Optional[Sequence[int]], priority: str,
@@ -59,6 +62,11 @@ class _Pending:
         # the submitter's trace scope, captured at the pool boundary so
         # the dispatch worker can restore parentage (obs/tracing.py)
         self.span = active_span()
+        # submitter's tenant (None when the tenant plane is off) and the
+        # stride-scheduling virtual time; seq as the default keeps the
+        # fair-share-off ordering exactly (rank, seq)
+        self.tenant = current_tenant_id()
+        self.vtime = float(seq)
 
 
 class _Resolved:
@@ -120,6 +128,7 @@ class QueryScheduler:
                  adaptive_window: bool = False,
                  window_min_ms: float = 0.2, window_max_ms: float = 5.0,
                  batch_holdoff_ms: float = 5.0,
+                 fair_share: bool = False,
                  clock=None, registry=None):
         self.executor = executor
         self.window_s = max(0.0, float(window_ms)) / 1000.0
@@ -161,6 +170,15 @@ class QueryScheduler:
         self._inflight_interactive = 0
         self._dispatch_interactive = 0
         self._last_interactive = float("-inf")
+        # weighted-fair admission ordering (stride scheduling): each
+        # tenant's arrivals advance its virtual time by 1/weight, and the
+        # head pick orders by (rank, vtime, seq) — a tenant flooding the
+        # queue runs its vtime ahead and naturally yields to the others.
+        # Toggled live by API.enable_tenants (order-independent wiring).
+        self.fair_share = bool(fair_share)
+        self.tenant_weight = None  # callable tenant -> weight, else 1.0
+        self._tenant_vtime = {}
+        self._vclock = 0.0
         self._worker = threading.Thread(
             target=self._loop, name="pilosa-sched", daemon=True)
         self._worker.start()
@@ -177,6 +195,8 @@ class QueryScheduler:
             window_min_ms=config.scheduler_window_min_ms,
             window_max_ms=config.scheduler_window_max_ms,
             batch_holdoff_ms=config.scheduler_batch_holdoff_ms,
+            fair_share=(config.tenants_enabled
+                        and config.tenants_fair_share),
         )
         kw.update(overrides)
         return cls(executor, **kw)
@@ -225,6 +245,8 @@ class QueryScheduler:
                 index, query, shards, priority,
                 now + deadline_s if deadline_s > 0 else None, now, self._seq)
             self._seq += 1
+            if self.fair_share:
+                self._assign_vtime_locked(pending)
             self._queue.append(pending)
             self.registry.gauge(obs_metrics.METRIC_SCHED_QUEUE_DEPTH,
                                 len(self._queue))
@@ -335,6 +357,31 @@ class QueryScheduler:
     def as_executor(self) -> "SchedulingExecutor":
         return SchedulingExecutor(self)
 
+    # -- weighted-fair ordering (stride scheduling) ------------------------
+
+    def set_fair_share(self, enabled: bool, weight_fn=None) -> None:
+        """Toggle weighted-fair ordering; ``weight_fn(tenant) -> float``
+        (typically TenantRegistry.weight) scales each tenant's stride."""
+        with self._lock:
+            self.fair_share = bool(enabled)
+            if weight_fn is not None:
+                self.tenant_weight = weight_fn
+            if not enabled:
+                self._tenant_vtime.clear()
+
+    def _assign_vtime_locked(self, pending: _Pending) -> None:
+        t = pending.tenant or DEFAULT_TENANT
+        pending.tenant = t
+        wf = self.tenant_weight
+        w = wf(t) if wf is not None else 1.0
+        v = (max(self._vclock, self._tenant_vtime.get(t, 0.0))
+             + 1.0 / max(1e-6, w))
+        self._tenant_vtime[t] = v
+        pending.vtime = v
+        if len(self._tenant_vtime) > 256:  # hostile-ID bound; the
+            # vclock floor keeps post-clear arrivals ordered sanely
+            self._tenant_vtime.clear()
+
     # -- adaptive window ---------------------------------------------------
 
     def _observe_arrival(self, now: float) -> None:
@@ -390,7 +437,7 @@ class QueryScheduler:
             if self._paused or not self._queue:
                 self._cv.wait()
                 continue
-            head = min(self._queue, key=lambda p: (p.rank, p.seq))
+            head = min(self._queue, key=lambda p: (p.rank, p.vtime, p.seq))
             now = self.clock.now()
             same = sum(1 for p in self._queue if p.key == head.key)
             window_s = self._window_s()
@@ -403,6 +450,10 @@ class QueryScheduler:
             # head paid up to the full window; later arrivals less)
             self._claim_window_s = min(max(0.0, now - head.enqueued),
                                        window_s)
+            if self.fair_share:
+                # global virtual time chases the dispatched head so an
+                # idle tenant re-enters at "now", not with banked credit
+                self._vclock = max(self._vclock, head.vtime)
             return self._take_locked(head.key, now)
 
     def _claim_locked(self, p: _Pending, now: float,
@@ -458,7 +509,7 @@ class QueryScheduler:
             (p for p in keep
              if (p.fusible and p.key.index == key.index
                  and p.key.family == key.family)),
-            key=lambda p: (p.rank, p.seq))
+            key=lambda p: (p.rank, p.vtime, p.seq))
         admitted: List[_Pending] = []
         merged_keys = set()
         for p in candidates:
@@ -501,8 +552,15 @@ class QueryScheduler:
         deadlines = [p.deadline for p in batch if p.deadline is not None]
         scope = (deadline_scope(Deadline(min(deadlines), self.clock.now))
                  if deadlines else deadline_scope(None))
+        # single-tenant batches dispatch under the submitter's tenant so
+        # cache fills land in the tenant-scoped namespace; a mixed batch
+        # (cross-tenant fusion) fills the shared namespace instead
+        tenants = {p.tenant for p in batch}
+        tscope = (tenant_scope(batch[0].tenant)
+                  if len(tenants) == 1 and batch[0].tenant is not None
+                  else contextlib.nullcontext())
         t0 = time.perf_counter()
-        with scope:
+        with scope, tscope:
             execute_batch(self.executor, batch)
         elapsed = time.perf_counter() - t0
         self.registry.observe_bucketed(
@@ -548,7 +606,8 @@ class QueryScheduler:
         with self._lock:
             return {"queue_depth": len(self._queue),
                     "inflight_admits": self._inflight_admits,
-                    "max_queue": self.max_queue}
+                    "max_queue": self.max_queue,
+                    "fair_share": self.fair_share}
 
     def close(self) -> None:
         with self._cv:

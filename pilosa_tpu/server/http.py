@@ -152,6 +152,10 @@ _ROUTES = [
     ("POST", re.compile(r"^/index/([^/]+)/stream/push$"), "post_stream_push"),
     ("GET", re.compile(r"^/internal/stats/stream$"), "get_stats_stream"),
     ("GET", re.compile(r"^/internal/slo$"), "get_slo"),
+    # tenant attribution plane (obs/tenants.py): per-tenant usage,
+    # quota state, fair-share weights — every tracked tenant, not just
+    # the top-K that get metric labels
+    ("GET", re.compile(r"^/internal/tenants$"), "get_internal_tenants"),
     ("GET", re.compile(r"^/internal/debug/bundles$"), "get_debug_bundles"),
     ("GET", re.compile(r"^/internal/debug/bundles/([^/]+)$"),
      "get_debug_bundle"),
@@ -248,7 +252,7 @@ class Handler(BaseHTTPRequestHandler):
     #: the caller sent a sampled traceparent header)
     _trace_span = None
 
-    def _send(self, code: int, payload: dict) -> None:
+    def _send(self, code: int, payload: dict, headers=None) -> None:
         sp = self._trace_span
         if sp is not None:
             # ship the serving node's finished span tree back to the
@@ -263,6 +267,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self._emit_cookies()
         self.end_headers()
         self.wfile.write(data)
@@ -333,6 +339,27 @@ class Handler(BaseHTTPRequestHandler):
                     if attempt and span.recording:
                         span.set_tag("attempt", attempt)
                     self._trace_span = span if span.recording else None
+                tenant_token = None
+                reg = getattr(self.api, "tenants", None)
+                if reg is not None:
+                    # attribution entry point: X-Tenant header (or
+                    # ?tenant= for curl-ability), clamped to a safe id,
+                    # never rejected — unattributed traffic just lands
+                    # on "default" (satellite 3's contract)
+                    from pilosa_tpu.obs.tenants import set_current_tenant
+
+                    raw = self.headers.get("x-tenant")
+                    if raw is None and "?" in self.path:
+                        from urllib.parse import parse_qs, urlsplit
+
+                        vals = parse_qs(urlsplit(self.path).query).get(
+                            "tenant")
+                        raw = vals[-1] if vals else None
+                    tenant = reg.resolve(raw)
+                    tenant_token = set_current_tenant(tenant)
+                    sp = self._trace_span
+                    if sp is not None and sp.recording:
+                        sp.set_tag("tenant", tenant)
                 try:
                     if self.auth is not None and name not in _AUTH_EXEMPT:
                         self._check_auth(name, match)
@@ -349,8 +376,13 @@ class Handler(BaseHTTPRequestHandler):
                     # gated by cluster state (reference: api.go:160)
                     self._send(412, {"error": str(e)})
                 except AdmissionError as e:
-                    # scheduler backpressure: shed load, retryable
-                    self._send(429, {"error": str(e)})
+                    # scheduler backpressure / tenant quota: shed load,
+                    # retryable; quota rejections say when to come back
+                    ra = getattr(e, "retry_after_s", None)
+                    self._send(429, {"error": str(e)},
+                               headers=({"Retry-After":
+                                         str(max(1, int(ra + 0.999)))}
+                                        if ra is not None else None))
                 except QueryDeadlineError as e:
                     self._send(408, {"error": str(e)})
                 except Exception as e:  # pragma: no cover - last resort
@@ -362,6 +394,14 @@ class Handler(BaseHTTPRequestHandler):
                     sp, self._trace_span = self._trace_span, None
                     if sp is not None:
                         sp.finish()
+                    if tenant_token is not None:
+                        # same leak hazard as the span: keep-alive reuses
+                        # this thread for the next (possibly tenant-less)
+                        # request
+                        from pilosa_tpu.obs.tenants import \
+                            reset_current_tenant
+
+                        reset_current_tenant(tenant_token)
                 return
         self._send(404, {"error": f"no route for {method} {self.path}"})
 
@@ -374,11 +414,37 @@ class Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._dispatch("DELETE")
 
+    # -- tenant quota gates ------------------------------------------------
+
+    def _charge_tenant_query(self) -> None:
+        """One unit against the current tenant's QPS bucket; raises
+        QuotaExceededError -> 429 + Retry-After when exhausted. No-op
+        when the tenant plane is off or the tenant is unlimited."""
+        reg = getattr(self.api, "tenants", None)
+        if reg is not None:
+            from pilosa_tpu.obs.tenants import current_tenant_id
+
+            reg.charge_query(current_tenant_id())
+
+    def _charge_tenant_ingest(self, rows: int, body=None) -> None:
+        """``rows`` against the current tenant's ingest bucket. Forwarded
+        internal legs (body["remote"]) are exempt: the entry node already
+        charged the whole batch, and double-charging fan-out would make
+        effective quota depend on cluster size."""
+        if body is not None and body.get("remote"):
+            return
+        reg = getattr(self.api, "tenants", None)
+        if reg is not None:
+            from pilosa_tpu.obs.tenants import current_tenant_id
+
+            reg.charge_ingest(current_tenant_id(), rows)
+
     # -- handlers ----------------------------------------------------------
 
     def post_query(self, index: str):
         """PQL query; body is raw PQL or JSON {"query": "..."} (reference:
         http_handler.go:1295 handlePostQuery)."""
+        self._charge_tenant_query()
         raw = self._body()
         ctype = (self.headers.get("Content-Type") or "").split(";")[0]
         if ctype == "application/json":
@@ -415,6 +481,7 @@ class Handler(BaseHTTPRequestHandler):
         """SQL query; body is the raw SQL text (reference:
         http_handler.go:536 POST /sql -> :1440 handlePostSQL)."""
         # SQLError subclasses ValueError -> _dispatch maps it to a 400
+        self._charge_tenant_query()
         text = self._body().decode()
         parsed = None
         if self.auth is not None:
@@ -530,6 +597,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_import(self, index: str):
         b = self._json_body()
+        self._charge_tenant_ingest(len(b.get("cols") or []), b)
         peer = self._gossip_apply(b)
         n = self.api.import_bits(
             index, self._require(b, "field"),
@@ -548,6 +616,9 @@ class Handler(BaseHTTPRequestHandler):
         import base64
 
         b = self._json_body()
+        # roaring blobs don't expose a row count pre-decode; charge one
+        # unit per view as a coarse rate signal
+        self._charge_tenant_ingest(len(b.get("views") or {}), b)
         peer = self._gossip_apply(b)
         views = {v: base64.b64decode(blob)
                  for v, blob in (b.get("views") or {}).items()}
@@ -558,6 +629,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_import_values(self, index: str):
         b = self._json_body()
+        self._charge_tenant_ingest(len(b.get("cols") or []), b)
         peer = self._gossip_apply(b)
         n = self.api.import_values(
             index, self._require(b, "field"), cols=b.get("cols", []),
@@ -680,6 +752,13 @@ class Handler(BaseHTTPRequestHandler):
             return
         self._send(200, {"enabled": True, **hp.slo.status()})
 
+    def get_internal_tenants(self):
+        reg = getattr(self.api, "tenants", None)
+        if reg is None:
+            self._send(200, {"enabled": False})
+            return
+        self._send(200, {"enabled": True, **reg.stats_json()})
+
     def get_stats_kernels(self):
         # the devprof registry is process-global (not hung off the
         # health plane), so an in-process LocalCluster's coordinator
@@ -701,7 +780,9 @@ class Handler(BaseHTTPRequestHandler):
         if svc is None or svc.index != index:
             raise KeyError(f"no stream service on index {index!r}")
         body = self._json_body()
-        self._send(200, svc.push(body.get("records") or []))
+        records = body.get("records") or []
+        self._charge_tenant_ingest(len(records))
+        self._send(200, svc.push(records))
 
     def get_debug_bundles(self):
         hp = self._health_plane()

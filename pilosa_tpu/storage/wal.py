@@ -58,6 +58,21 @@ _SEG_RE = re.compile(r"\.(\d{8})$")
 
 DEFAULT_SEGMENT_BYTES = 4 << 20
 
+# Process-wide append observer: called with the framed byte count of
+# every appended record, AFTER the WAL lock is released. The tenant
+# attribution plane (obs/tenants.py) chains through it to charge WAL
+# bytes to the writing tenant; None (the default) costs one load per
+# append.
+_APPEND_HOOK = None
+
+
+def set_append_hook(hook) -> None:
+    """Install (or clear, with None) the per-append byte observer
+    (``(nbytes: int) -> None``). Chain by capturing the previous value
+    before installing."""
+    global _APPEND_HOOK
+    _APPEND_HOOK = hook
+
 
 def fsync_dir(path: str) -> None:
     """fsync a directory so renames/creates/unlinks inside it survive
@@ -285,7 +300,10 @@ class WAL:
                 self._flush_locked()
             if seg.record_bytes + _HDR.size >= self.segment_bytes:
                 self._rotate_locked()
-            return lsn
+        hook = _APPEND_HOOK
+        if hook is not None:  # outside the lock: accounting never blocks I/O
+            hook(len(framed))
+        return lsn
 
     def _flush_locked(self) -> None:
         if not self._dirty:

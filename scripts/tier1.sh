@@ -154,6 +154,27 @@ echo "== devprof overhead bench gate (bench.py --configs 16) =="
 # disabled, and a profile with MFU/GB/s for every compiled family.
 JAX_PLATFORMS=cpu python bench.py --configs 16 || exit $?
 
+echo "== tenant lane (PILOSA_TPU_TENANTS=1, fault seeds 1 / 7) =="
+# The tenant attribution plane bootstraps on every API in these suites
+# (attribution-only defaults: quotas 0, no enforcement): results must
+# stay bit-identical with per-tenant accounting, tenant-scoped cache
+# namespaces, and the scheduler's fair-share ordering live; the seeds
+# steer the prob-gated faults the cluster suites inject underneath.
+for seed in 1 7; do
+    PILOSA_TPU_TENANTS=1 PILOSA_TPU_FAULT_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_tenants.py tests/test_sched.py \
+        tests/test_health.py -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly || exit $?
+done
+
+echo "== noisy-neighbor bench gate (bench.py --configs 18) =="
+# Hard-asserts the ISSUE 14 acceptance bar in-process: with an abusive
+# tenant flooding a 3-node cluster under chaos, well-behaved tenants'
+# p99 stays within 1.5x their no-abuser baseline, results bit-identical,
+# the abuser alone trips the tenant SLO burn + a tenant_burn flight
+# bundle, and zero tenant-plane scopes are entered when disabled.
+JAX_PLATFORMS=cpu python bench.py --configs 18 || exit $?
+
 echo "== streaming ingest bench gate (bench.py --configs 17) =="
 # Hard-asserts the ISSUE 13 acceptance bar in-process: pipelined chunked
 # ingest >= 2x the classic c1 path on the same hardware, bit-identical
