@@ -1,0 +1,22 @@
+"""Concurrency-correctness plane.
+
+Two halves, one invariant set:
+
+- :mod:`pilosa_tpu.analysis.locktrace` — the *dynamic* half: an
+  instrumented lock wrapper project locks opt into via
+  ``tracked_lock(name)``. Records the per-thread lock-acquisition
+  graph, detects cycles (potential deadlocks) and locks held across
+  device dispatches or blocking socket I/O. Zero overhead when
+  ``PILOSA_TPU_LOCKCHECK`` is off.
+- :mod:`pilosa_tpu.analysis.lint` — the *static* half: an AST-based
+  project-invariant linter (driven by ``scripts/lint_invariants.py``)
+  enforcing the invariants this codebase states in prose — injectable
+  clocks, tracked locks, callbacks outside lock bodies, device calls
+  behind :mod:`pilosa_tpu.platform`, contextvar set/reset pairing and
+  metrics-label cardinality — against a checked-in, ratcheted
+  baseline (``analysis/baseline.json``).
+
+This package must stay import-light: ``obs.metrics`` and ``platform``
+import :mod:`locktrace` at module scope, so nothing here may import
+back into the engine at import time.
+"""

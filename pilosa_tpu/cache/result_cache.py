@@ -26,6 +26,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.obs import metrics as M
 from pilosa_tpu.obs.tracing import active_span, get_tracer
 
@@ -103,7 +104,7 @@ class ResultCache:
         self.ttl_ms = float(ttl_ms)
         self.registry = registry if registry is not None else M.REGISTRY
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cache.result_cache")
         self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
         self._bytes = 0
         self._inflight: Dict[Tuple, Future] = {}

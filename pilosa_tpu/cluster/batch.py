@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.cluster.client import LegCancelled, RemoteError
 from pilosa_tpu.obs import metrics as obs_metrics
 from pilosa_tpu.obs.tracing import active_span, get_tracer
@@ -127,7 +128,7 @@ class NodeBatcher:
             window_min_s=max(0.0, float(window_min_ms)) / 1e3,
             window_max_s=max(0.0, float(window_max_ms)) / 1e3,
             max_batch=self.max_batch)
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.batch")
         self._slots: Dict[str, _Slot] = {}
 
     @classmethod

@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from pilosa_tpu.analysis import locktrace
+
 # Message types (reference: broadcast.go:55-77 messageType* values).
 MSG_CREATE_INDEX = "create-index"
 MSG_DELETE_INDEX = "delete-index"
@@ -112,7 +114,7 @@ class GossipBroadcaster(Broadcaster):
     def __init__(self, inner: Broadcaster, agent):
         self.inner = inner
         self.agent = agent
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.broadcast")
         self._n = 0  # per-origin message counter: each message its own key
 
     def _record(self, msg: Dict) -> bool:

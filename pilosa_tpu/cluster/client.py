@@ -32,6 +32,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence
 from urllib.parse import urlsplit
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.obs.tenants import current_tenant_id
 from pilosa_tpu.obs.tracing import active_span, current_traceparent
 
@@ -66,7 +67,7 @@ class _ConnPool:
 
     def __init__(self, per_key: int = 4):
         self.per_key = max(1, int(per_key))
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.client.pool")
         self._idle: Dict[str, List[http.client.HTTPConnection]] = {}
         self.hits = 0
         self.misses = 0
@@ -138,7 +139,7 @@ class InternalClient:
         # attempt, retries included) — bench.py compares batched vs
         # unbatched fan-out RPC counts from these
         self.op_counts: Dict[str, int] = {}
-        self._count_lock = threading.Lock()
+        self._count_lock = locktrace.tracked_lock("cluster.client.counts")
 
     def evict_node(self, node_id: str) -> int:
         """Drop pooled sockets for a peer; ClusterNode wires this to the
@@ -153,6 +154,11 @@ class InternalClient:
     def _request(self, method: str, url: str, body: Optional[bytes] = None,
                  ctype: str = "application/json", node_id: Optional[str] = None,
                  token=None, op: Optional[str] = None) -> dict:
+        if locktrace.ACTIVE is not None:
+            # the wire boundary: any lock held here is held across
+            # blocking socket I/O (and loopback HTTP re-enters the
+            # server, so it is also a latent distributed deadlock)
+            locktrace.ACTIVE.note_io("cluster.client._request")
         last: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             if token is not None and token.cancelled:

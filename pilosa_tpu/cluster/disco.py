@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.cluster.topology import (
     Node, NODE_STATE_STARTED, ClusterSnapshot, STATE_NORMAL,
 )
@@ -55,7 +56,7 @@ class InMemDisCo(DisCo):
     way clustertests pause containers."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.disco.inmem")
         self._nodes: Dict[str, Node] = {}
         self._live: Dict[str, bool] = {}
 
@@ -108,7 +109,7 @@ class StaticDisCo(DisCo):
         self._nodes = sorted(nodes, key=lambda n: n.id)
         self._probe = probe
         self._interval = probe_interval
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.disco.static")
         self._state: Dict[str, bool] = {n.id: True for n in self._nodes}
         self._checked: Dict[str, float] = {}
 
@@ -190,7 +191,7 @@ class LeaseDisCo(DisCo):
         # dead until its NEXT heartbeat, like the reference's down-node
         # confirmation loop (cluster.go:23)
         self._forced_down: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.disco.lease")
 
     # -- join / leave / heartbeat -----------------------------------------
 

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Set
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.api import API
 from pilosa_tpu.cluster import broadcast as B
 from pilosa_tpu.cluster.client import InternalClient
@@ -66,7 +67,7 @@ class ClusterNode:
         self._sql_engine = None  # lazily built by API.sql (shared impl)
         self._remote_shards: Dict[str, Set[int]] = {}
         self._announced: Dict[str, Set[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.node")
         self.executor = ClusterExecutor(
             node_id, self.api.holder, self.client, self.snapshot,
             self.all_shards, on_node_down=self._mark_down,

@@ -51,6 +51,7 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import wait as futures_wait
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.cluster.client import LegCancelled, NodeDownError
 from pilosa_tpu.obs import metrics as obs_metrics
 from pilosa_tpu.obs.tracing import get_tracer
@@ -95,7 +96,7 @@ class LatencyTracker:
 
     def __init__(self, window: int = 64):
         self.window = max(4, int(window))
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.resilience.latency")
         self._per_node: Dict[str, deque] = {}
         self._global: deque = deque(maxlen=self.window)
 
@@ -166,7 +167,7 @@ class CircuitBreaker:
         self.registry = registry if registry is not None else (
             obs_metrics.REGISTRY)
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.resilience.breaker")
         self._slots: Dict[str, _BreakerSlot] = {}
         # extra observers of LOCAL transitions (gossip publishes these to
         # peers); not fired for apply_remote, so a gossiped state never
@@ -189,21 +190,37 @@ class CircuitBreaker:
         return s
 
     def _transition(self, node_id: str, slot: _BreakerSlot,
-                    to: str, notify: bool = True) -> None:
+                    to: str, notify: bool = True
+                    ) -> Optional[Tuple[str, str, str]]:
+        """State change + metrics, under the caller's lock. Returns the
+        (node_id, frm, to) event the caller must pass to :meth:`_fire`
+        AFTER releasing ``self._lock`` (None when nothing to fire):
+        ``on_transition``/listeners are arbitrary external callbacks —
+        gossip publishes, health-plane hooks — and invoking one while
+        holding the breaker lock is the exact deadlock shape the health
+        plane once dodged (a listener that calls back into ``state()``/
+        ``allow()`` self-deadlocks; one that takes its own lock inverts
+        against that lock's holders calling into the breaker)."""
         frm = slot.state
         if frm == to:
-            return
+            return None
         slot.state = to
         slot.changed_at = self.clock.now()
         self.registry.gauge(obs_metrics.METRIC_CLUSTER_BREAKER_STATE,
                             _BREAKER_GAUGE[to], node=node_id)
         self.registry.count(obs_metrics.METRIC_CLUSTER_BREAKER_TRANSITIONS,
                             node=node_id, to=to)
+        return (node_id, frm, to) if notify else None
+
+    def _fire(self, event: Optional[Tuple[str, str, str]]) -> None:
+        """Deliver a transition event outside the lock (no-op on None)."""
+        if event is None:
+            return
+        node_id, frm, to = event
         if self._on_transition is not None:
             self._on_transition(node_id, frm, to)
-        if notify:
-            for fn in list(self._listeners):
-                fn(node_id, frm, to)
+        for fn in list(self._listeners):
+            fn(node_id, frm, to)
 
     def apply_remote(self, node_id: str, state: str) -> bool:
         """Adopt a peer's gossiped breaker observation. Open/half-open
@@ -250,21 +267,27 @@ class CircuitBreaker:
         half-open probe as a side effect, so only call when a granted
         leg will actually be sent."""
         now = self.clock.now()
-        with self._lock:
-            slot = self._slot(node_id)
-            if slot.state == BREAKER_CLOSED:
-                return True
-            if slot.state == BREAKER_OPEN:
-                if now - slot.changed_at >= self.open_s:
-                    self._transition(node_id, slot, BREAKER_HALF_OPEN)
+        event = None
+        try:
+            with self._lock:
+                slot = self._slot(node_id)
+                if slot.state == BREAKER_CLOSED:
+                    return True
+                if slot.state == BREAKER_OPEN:
+                    if now - slot.changed_at >= self.open_s:
+                        event = self._transition(node_id, slot,
+                                                 BREAKER_HALF_OPEN)
+                        slot.probe_at = now
+                        return True
+                    return False
+                # half-open: one probe outstanding; re-grant if expired
+                if slot.probe_at is None or \
+                        now - slot.probe_at >= self.open_s:
                     slot.probe_at = now
                     return True
                 return False
-            # half-open: one probe outstanding; re-grant if it expired
-            if slot.probe_at is None or now - slot.probe_at >= self.open_s:
-                slot.probe_at = now
-                return True
-            return False
+        finally:
+            self._fire(event)
 
     def record_success(self, node_id: str) -> None:
         with self._lock:
@@ -272,19 +295,22 @@ class CircuitBreaker:
             slot.failures = 0
             slot.probe_at = None
             slot.remote = False  # our own evidence from here on
-            self._transition(node_id, slot, BREAKER_CLOSED)
+            event = self._transition(node_id, slot, BREAKER_CLOSED)
+        self._fire(event)
 
     def record_failure(self, node_id: str) -> None:
+        event = None
         with self._lock:
             slot = self._slot(node_id)
             slot.probe_at = None
             slot.remote = False  # our own evidence from here on
             if slot.state == BREAKER_HALF_OPEN:
-                self._transition(node_id, slot, BREAKER_OPEN)
-                return
-            slot.failures += 1
-            if slot.failures >= self.threshold:
-                self._transition(node_id, slot, BREAKER_OPEN)
+                event = self._transition(node_id, slot, BREAKER_OPEN)
+            else:
+                slot.failures += 1
+                if slot.failures >= self.threshold:
+                    event = self._transition(node_id, slot, BREAKER_OPEN)
+        self._fire(event)
 
 
 # -- deterministic fault injection ------------------------------------------
@@ -374,7 +400,7 @@ class FaultPlan:
             seed = int(os.environ.get("PILOSA_TPU_FAULT_SEED", "0"))
         self.seed = int(seed)
         self._sleep = sleep if sleep is not None else time.sleep
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("cluster.resilience.faultplan")
         self._rules: Dict[str, List[_FaultRule]] = {}
         self._links: List[_LinkRule] = []
         self._counts: Dict[str, int] = {}

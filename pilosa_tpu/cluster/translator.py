@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, List, Optional
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.cluster.client import NodeDownError, RemoteError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -42,7 +43,7 @@ class ClusterTranslator:
         # vanish — a promoted replica then re-allocated those ids to
         # different keys (round-5 advisor finding).
         self._outbox: Dict[tuple, List] = {}
-        self._outbox_lock = threading.Lock()
+        self._outbox_lock = locktrace.tracked_lock("cluster.translator.outbox")
         # gossip hook (ClusterNode.enable_membership): fn(index, field,
         # entries, batch_no) publishes new entries on the gossip plane so
         # replicas a partition hides from US still converge via peers

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from pilosa_tpu import platform
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 _FRAME_RE = re.compile(r"shard\.(\d+)\.npz$")
@@ -206,7 +207,7 @@ class DataframeStore:
                     col = frame.columns[name]
                     host[si, : col.size] = col.astype(np.float32)
                     vmask[si, : col.size] = frame.valid[name][: col.size]
-                cols[name] = jax.device_put(host)
+                cols[name] = platform.h2d_copy(host)
                 valid_np &= vmask
         else:
             # count() with no columns: any row present in any column
@@ -217,7 +218,7 @@ class DataframeStore:
                     continue
                 for v in frame.valid.values():
                     valid_np[si, : v.size] |= v
-        valid = jax.device_put(valid_np)
+        valid = platform.h2d_copy(valid_np)
         with self._lock:
             self._device_cache[key] = (vers, cols, valid, cap)
             while len(self._device_cache) > 8:

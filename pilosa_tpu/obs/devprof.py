@@ -63,6 +63,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu import platform
 from pilosa_tpu.config import env_bool
 from pilosa_tpu.obs import metrics as M
@@ -198,7 +199,7 @@ class KernelProfileRegistry:
     LocalCluster's coordinator endpoint sees every node's dispatches."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.devprof.kernels")
         self._profiles: Dict[Tuple[str, int, int], KernelProfile] = {}
         # (kind, tape, n_leaves, masked, total_words, epoch) ->
         # (profile, flops/dispatch, bytes/dispatch); re-derivable, so a
@@ -360,7 +361,7 @@ class IngestAccounting:
     bytes per named stage, republished as ``ingest_stage_*`` rates."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.devprof.ingest")
         # stage -> [seconds, rows, bytes, batches]
         self._stages: Dict[str, list] = {}
 

@@ -21,6 +21,10 @@ triggers
 - ``membership_flap`` membership status transitions inside the flap
                       window crossed the threshold (a link or node
                       oscillating alive<->suspect — gossip/membership.py)
+- ``lock_violation``  the lock tracer's violation count grew: a
+                      lock-order cycle or a lock held across device
+                      dispatch / blocking I/O (analysis/locktrace.py;
+                      only fires under PILOSA_TPU_LOCKCHECK=1)
 
 bundle contents: the trailing timeline window, SLO status, slow traces
 from the trace store (IDs resolve at /internal/traces/{id}), the
@@ -31,8 +35,9 @@ transitions recorded by the cluster listener).
 Per-trigger cooldowns stop a sustained anomaly from flooding the ring.
 Served at GET /internal/debug/bundles{,/id}. Clock injectable; the
 breaker listener only appends to the event ring (never captures
-synchronously — CircuitBreaker notifies listeners under its own lock,
-and a capture reads breaker state back).
+synchronously — CircuitBreaker now fires listeners outside its lock,
+but a synchronous capture would still read breaker state back from
+inside the transition path).
 """
 
 from __future__ import annotations
@@ -45,6 +50,8 @@ from typing import Dict, List, Optional
 
 from . import metrics as obs_metrics
 from .timeline import WallClock
+
+from pilosa_tpu.analysis import locktrace
 
 
 class FlightRecorder:
@@ -70,12 +77,16 @@ class FlightRecorder:
         self.dump_dir = dump_dir or ""
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or WallClock()
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.flight")
         self._bundles: deque = deque(maxlen=max(1, int(capacity)))
         self._events: deque = deque(maxlen=64)
         self._last_fire: Dict[str, float] = {}
         self._seq = 0
         self._plane = None
+        # high-water mark of tracer violations already bundled, so a
+        # sustained count only fires when it GROWS (cooldown still caps
+        # a fast-growing one)
+        self._lock_violations_seen = 0
 
     def bind(self, plane) -> None:
         """Attach the owning HealthPlane (timeline/slo/trace access for
@@ -170,6 +181,18 @@ class FlightRecorder:
                 b = self.trigger(
                     "ingest_stall",
                     f"streaming ingest stalled: {why}", sample)
+                if b:
+                    fired.append(b)
+
+        locks = probes.get("locks")
+        if isinstance(locks, dict) and locks.get("enabled"):
+            seen = locks.get("violations", 0) or 0
+            if seen > self._lock_violations_seen:
+                self._lock_violations_seen = seen
+                b = self.trigger(
+                    "lock_violation",
+                    f"{seen} lock-discipline violations "
+                    f"({locks.get('cycles', 0)} cycles)", sample)
                 if b:
                     fired.append(b)
 

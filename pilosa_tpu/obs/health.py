@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from pilosa_tpu.analysis import locktrace
+
 from . import metrics as obs_metrics
 from .flight import FlightRecorder
 from .slo import Objective, SLOTracker
@@ -121,6 +123,10 @@ class HealthPlane:
         # probes.slo carries the current burn and the published gauges
         # land in the registry for /metrics and the next sample
         self.timeline.add_probe("slo", self._slo_probe)
+        # lock tracer (analysis/locktrace.py): {"enabled": false} noise-
+        # free when PILOSA_TPU_LOCKCHECK is off; the flight recorder's
+        # lock_violation trigger watches the violation count
+        self.timeline.add_probe("locks", locktrace.timeline_probe)
         self.timeline.add_observer(self.flight.observe)
 
     @classmethod

@@ -9,10 +9,11 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import threading
-import time
 import uuid
 from typing import Deque, List, Optional
+
+from pilosa_tpu.analysis import locktrace
+from pilosa_tpu.obs.metrics import EpochClock
 
 
 @dataclasses.dataclass
@@ -44,9 +45,10 @@ class ExecutionRecord:
 class ExecutionRequestsAPI:
     """Fixed-capacity ring (reference: systemlayer.go 100-entry ring)."""
 
-    def __init__(self, capacity: int = 100):
+    def __init__(self, capacity: int = 100, clock=None):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._clock = clock or EpochClock()
+        self._lock = locktrace.tracked_lock("obs.history.ring")
         # deque(maxlen) evicts the oldest record in O(1) on append; the
         # old list.pop(0) shifted the whole ring on every eviction
         self._ring: Deque[ExecutionRecord] = collections.deque(
@@ -55,14 +57,15 @@ class ExecutionRequestsAPI:
     def begin(self, index: str, query: str, language: str) -> ExecutionRecord:
         rec = ExecutionRecord(
             request_id=str(uuid.uuid4()), index=index, query=query,
-            language=language, start_time=time.time())
+            language=language, start_time=self._clock.now())
         with self._lock:
             self._ring.append(rec)
         return rec
 
     def end(self, rec: ExecutionRecord, error: Optional[str] = None) -> None:
         with self._lock:  # readers copy under the same lock
-            rec.runtime_ns = int((time.time() - rec.start_time) * 1e9)
+            rec.runtime_ns = int(
+                (self._clock.now() - rec.start_time) * 1e9)
             rec.error = error or ""
             rec.status = "error" if error else "complete"
 

@@ -13,9 +13,10 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
-import time
 from typing import Any, List, Optional
+
+from pilosa_tpu.analysis import locktrace
+from pilosa_tpu.obs.metrics import EpochClock
 
 _ROOT = "pilosa_tpu"
 
@@ -64,9 +65,10 @@ class QueryLogger:
     """Append-only structured query log (reference: server/server.go:792
     query logger — one line per query with timing and outcome)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, clock=None):
         self.path = path
-        self._lock = threading.Lock()
+        self._clock = clock or EpochClock()
+        self._lock = locktrace.tracked_lock("obs.logger.query_log")
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -75,7 +77,7 @@ class QueryLogger:
             error: Optional[str] = None, trace_id: str = "",
             request_id: str = "") -> None:
         rec = {
-            "ts": time.time(),
+            "ts": self._clock.now(),
             "kind": kind,  # pql | sql | slow
             "index": index,
             "query": query[:4096],

@@ -9,9 +9,10 @@ reference keep working; served at /metrics (text) and /metrics.json
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from pilosa_tpu.analysis import locktrace
 
 # Series names mirrored from the reference (metrics.go:7-57).
 METRIC_CREATE_INDEX = "create_index_total"
@@ -233,6 +234,11 @@ METRIC_TENANT_CACHE_BYTES = "tenant_cache_bytes_total"
 METRIC_TENANT_WAL_BYTES = "tenant_wal_bytes_total"
 METRIC_TENANT_UNATTRIBUTED = "tenant_unattributed_total"
 METRIC_TENANT_TRACKED = "tenant_tracked"
+# concurrency-correctness plane (analysis/locktrace.py): lock-order
+# cycles, locks held across device dispatch, and locks held across
+# blocking socket I/O observed by the tracer (labelled kind=), counted
+# only while PILOSA_TPU_LOCKCHECK is on
+METRIC_LOCK_VIOLATIONS = "lock_order_violations_total"
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
@@ -250,16 +256,28 @@ def set_exemplar_provider(fn) -> None:
     _EXEMPLAR_PROVIDER = fn
 
 
+class EpochClock:
+    """Injectable wall clock for exemplar timestamps: ``now()`` is Unix
+    epoch seconds. Distinct from ``timeline.WallClock`` (monotonic, for
+    intervals) — exemplar timestamps must be real dates because the
+    OpenMetrics line carries them to Grafana. The ``*Clock`` suffix is
+    the linter's marker that raw ``time.time()`` lives here on purpose."""
+
+    def now(self) -> float:
+        return time.time()
+
+
 class MetricsRegistry:
     """Thread-safe counters/gauges/summaries (a summary keeps _count and
     _sum, enough for rate+mean dashboards; the reference's prometheus
     client keeps quantiles we don't need for parity of names)."""
 
     def __init__(self, namespace: str = "pilosa",
-                 exemplars: bool = False):
+                 exemplars: bool = False, clock=None):
         self.namespace = namespace
         self.exemplars = exemplars
-        self._lock = threading.Lock()
+        self._clock = clock or EpochClock()
+        self._lock = locktrace.tracked_lock("obs.metrics.registry")
         self._counters: Dict[_Key, float] = {}
         self._gauges: Dict[_Key, float] = {}
         self._summaries: Dict[_Key, Tuple[int, float]] = {}
@@ -320,7 +338,7 @@ class MetricsRegistry:
                     tid = _EXEMPLAR_PROVIDER()
                 if tid:
                     self._exemplars.setdefault(k, {})[idx] = (
-                        tid, value, time.time())
+                        tid, value, self._clock.now())
 
     def histogram(self, name: str, **labels) -> Optional[dict]:
         """Snapshot of one histogram series (None if never observed)."""

@@ -28,6 +28,8 @@ from typing import Dict, List, Optional
 from . import metrics as obs_metrics
 from .timeline import WallClock
 
+from pilosa_tpu.analysis import locktrace
+
 
 @dataclasses.dataclass(frozen=True)
 class Objective:
@@ -78,7 +80,7 @@ class SLOTracker:
         self.min_events = int(min_events)
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or WallClock()
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.slo")
         # each bucket: {"t": start, "surfaces": {surface:
         #   {"total": n, "errors": n, "bad": {objective_name: n}}}}
         maxlen = int(self.slow_window_s / self.bucket_s) + 2

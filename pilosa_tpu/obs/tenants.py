@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.errors import QuotaExceededError
 
 from . import metrics as obs_metrics
@@ -186,7 +187,7 @@ class TenantRegistry:
         self.qps_burst_s = max(0.1, float(qps_burst_s))
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.tenants")
         self._stats: Dict[str, _TenantStats] = {}
         self._qps: Dict[str, TokenBucket] = {}
         self._ingest: Dict[str, TokenBucket] = {}

@@ -30,6 +30,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from . import metrics as obs_metrics
 
+from pilosa_tpu.analysis import locktrace
+
 
 class WallClock:
     """Default monotonic time source. Any object with ``now()`` works
@@ -74,7 +76,7 @@ class TimelineSampler:
         self.interval_s = max(0.001, float(interval_ms) / 1e3)
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or WallClock()
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.timeline")
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self._probes: Dict[str, Callable[[], Any]] = {}
         self._observers: List[Callable[[dict], None]] = []

@@ -31,6 +31,7 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.obs import metrics as M
 
 _TRACE_ID_LEN = 32
@@ -243,7 +244,7 @@ class TraceStore:
                  registry: Optional[M.MetricsRegistry] = None):
         self.capacity = max(1, int(capacity))
         self.registry = registry if registry is not None else M.REGISTRY
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("obs.tracing.store")
         self._traces: "OrderedDict[str, dict]" = OrderedDict()
 
     def add(self, root: Span) -> None:

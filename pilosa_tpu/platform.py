@@ -13,8 +13,9 @@ from __future__ import annotations
 import contextlib
 import os
 import re
-import threading
 import time
+
+from pilosa_tpu.analysis import locktrace
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
 
@@ -96,7 +97,11 @@ def force_cpu_platform(n_devices: int | None = None):
 # recompile, and CPU still sees at most one sharded program in flight.
 # ---------------------------------------------------------------------------
 
-_DISPATCH_LOCK = threading.RLock()
+# dispatch_ok: the dispatch lock is the one lock that MUST be held
+# across the launch — that is its whole job; the tracer flags every
+# OTHER lock held at a dispatch site (the leaf-lock rule, enforced).
+_DISPATCH_LOCK = locktrace.tracked_lock("platform.dispatch", rlock=True,
+                                        dispatch_ok=True)
 _NULL_GUARD = contextlib.nullcontext()
 _GUARD_IS_LOCK: bool | None = None
 
@@ -166,6 +171,8 @@ def h2d_copy(host, sharding=None):
     from pilosa_tpu.obs.tracing import get_tracer
 
     arr = np.asarray(host)
+    if locktrace.ACTIVE is not None:
+        locktrace.ACTIVE.note_dispatch("platform.h2d_copy")
     hook = _H2D_HOOK
     if hook is None:
         with dispatch_guard():
@@ -200,6 +207,8 @@ def guarded_call(fn):
     @functools.wraps(fn)
     def call(*args, **kwargs):
         guard = dispatch_guard()
+        if locktrace.ACTIVE is not None:
+            locktrace.ACTIVE.note_dispatch("platform.guarded_call")
         tracer = get_tracer()
         hook = _DISPATCH_HOOK
         if hook is None:

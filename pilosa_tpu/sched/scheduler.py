@@ -18,6 +18,7 @@ import time
 from concurrent.futures import CancelledError, Future
 from typing import List, Optional, Sequence, Union
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.errors import AdmissionError, QueryDeadlineError
 from pilosa_tpu.obs import metrics as obs_metrics
 from pilosa_tpu.obs.tenants import (DEFAULT_TENANT, current_tenant_id,
@@ -152,7 +153,7 @@ class QueryScheduler:
         self.clock = clock if clock is not None else MonotonicClock()
         self.registry = registry if registry is not None else (
             obs_metrics.REGISTRY)
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("sched.scheduler")
         self._cv = threading.Condition(self._lock)
         self.clock.attach(self._cv)
         self._queue: List[_Pending] = []

@@ -137,6 +137,11 @@ _ROUTES = [
     ("GET", re.compile(r"^/metrics$"), "get_metrics"),
     ("GET", re.compile(r"^/metrics\.json$"), "get_metrics_json"),
     ("GET", re.compile(r"^/query-history$"), "get_query_history"),
+    # concurrency-correctness plane (analysis/locktrace.py): lock-order
+    # graph + cycle/dispatch/io violations ({"enabled": false} when the
+    # PILOSA_TPU_LOCKCHECK tracer is off)
+    ("GET", re.compile(r"^/internal/analysis/locks$"),
+     "get_analysis_locks"),
     # distributed traces (obs/tracing.py TraceStore): summaries + one
     # assembled span tree per trace id
     ("GET", re.compile(r"^/internal/traces$"), "get_internal_traces"),
@@ -797,6 +802,14 @@ class Handler(BaseHTTPRequestHandler):
         if hp is None:
             raise KeyError("health plane disabled (enable [obs.timeline])")
         self._send(200, hp.flight.get(bundle_id))  # KeyError -> 404
+
+    def get_analysis_locks(self):
+        """Lock-acquisition graph + violations from the lock tracer
+        (analysis/locktrace.py); {"enabled": false} with empty tables
+        when PILOSA_TPU_LOCKCHECK is off."""
+        from pilosa_tpu.analysis import locktrace
+
+        self._send(200, locktrace.report())
 
     def get_internal_traces(self):
         """Newest-first summaries of finished traces (the span trees stay

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.storage.wal import fsync_dir, iter_frames
 
 log = logging.getLogger(__name__)
@@ -96,7 +97,7 @@ class CrashPlan:
         self._hits: Dict[str, int] = {}
         self.dead = False
         self.fired: Optional[Tuple[str, int]] = None
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("storage.recovery.crashplan")
 
     def kill(self, site: str, at: int = 1) -> "CrashPlan":
         if site not in CRASH_SITES and site not in STREAM_CRASH_SITES:
@@ -397,7 +398,7 @@ class RecoveryManager:
         self.node = node
         self.batch_bytes = max(1, int(batch_bytes))
         self.registry = registry if registry is not None else M.REGISTRY
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("storage.recovery.manager")
         self._active: Set[str] = set()  # indexes mid-catch-up
         self._queued: Dict[str, List[Callable[[], Any]]] = {}
 

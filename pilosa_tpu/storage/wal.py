@@ -46,6 +46,7 @@ import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.obs import devprof
 
 # crc32 over (lsn bytes || payload), payload length, lsn
@@ -163,7 +164,7 @@ class WAL:
         # wal.append / wal.flush kill sites; once it has fired, this
         # "process" is dead and every hooked operation silently no-ops.
         self.crash_plan = crash_plan
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked_lock("storage.wal")
         self._dir = os.path.dirname(path)
         os.makedirs(self._dir, exist_ok=True)
         self._lsn = 0

@@ -11,14 +11,13 @@ lazily. Served at /transaction(s) endpoints (http_handler.go:528-533).
 from __future__ import annotations
 
 import dataclasses
-import threading
-import time
 import uuid
 from typing import Dict, List, Optional
 
+from pilosa_tpu.analysis import locktrace
 from pilosa_tpu.obs.metrics import (
     METRIC_EXCLUSIVE_TXN_REQUEST, METRIC_TXN_BLOCKED, METRIC_TXN_END,
-    METRIC_TXN_START, REGISTRY)
+    METRIC_TXN_START, REGISTRY, EpochClock)
 
 
 class TransactionError(ValueError):
@@ -46,9 +45,10 @@ class Transaction:
 class TransactionManager:
     """Reference: transaction.go:56 TransactionManager."""
 
-    def __init__(self, default_timeout_s: float = 300.0):
+    def __init__(self, default_timeout_s: float = 300.0, clock=None):
         self.default_timeout_s = default_timeout_s
-        self._lock = threading.Lock()
+        self._clock = clock or EpochClock()
+        self._lock = locktrace.tracked_lock("transaction.manager")
         self._txs: Dict[str, Transaction] = {}
         # Cluster sync hook (reference: server.go:1082 — transaction
         # changes broadcast to peers so exclusive state excludes
@@ -73,7 +73,7 @@ class TransactionManager:
                     timeout_s=float(tx_json.get("timeout")
                                     or self.default_timeout_s),
                     deadline=float(tx_json.get("deadline")
-                                   or time.time() + self.default_timeout_s),
+                                   or self._clock.now() + self.default_timeout_s),
                 )
             elif action == "finish":
                 self._txs.pop(tx_json.get("id"), None)
@@ -83,7 +83,7 @@ class TransactionManager:
                     f"unknown transaction sync action {action!r}")
 
     def _expire_locked(self) -> None:
-        now = time.time()
+        now = self._clock.now()
         # pending exclusives expire too — otherwise an expired blocker
         # leaves them pending forever and the manager deadlocks
         for tid in [t.id for t in self._txs.values() if t.deadline < now]:
@@ -97,7 +97,8 @@ class TransactionManager:
         exclusives = [t for t in self._txs.values() if t.exclusive]
         if len(self._txs) == 1 and exclusives and not exclusives[0].active:
             exclusives[0].active = True
-            exclusives[0].deadline = time.time() + exclusives[0].timeout_s
+            exclusives[0].deadline = (self._clock.now()
+                                      + exclusives[0].timeout_s)
 
     def start(self, tid: Optional[str] = None, timeout_s: Optional[float] = None,
               exclusive: bool = False) -> Transaction:
@@ -120,7 +121,7 @@ class TransactionManager:
             active = not exclusive or not self._txs
             tx = Transaction(id=tid, active=active, exclusive=exclusive,
                              timeout_s=timeout_s,
-                             deadline=time.time() + timeout_s)
+                             deadline=self._clock.now() + timeout_s)
             self._txs[tid] = tx
             REGISTRY.count(METRIC_TXN_START)
         self._notify("start", tx)

@@ -14,6 +14,28 @@ cd "$(dirname "$0")/.."
 echo "== compileall syntax smoke =="
 python -m compileall -q pilosa_tpu || exit $?
 
+echo "== analysis lane: project-invariant linter =="
+# Static half of the concurrency-correctness plane: every rule runs
+# against the checked-in ratcheted baseline — any NEW violation (raw
+# time in clock modules, bare locks in migrated packages, callbacks
+# under locks, device calls outside platform, unreset contextvars,
+# unbounded metric labels) fails the build. --selftest first proves the
+# gate logic itself (one positive + one negative fixture per rule).
+python scripts/lint_invariants.py --selftest || exit $?
+python scripts/lint_invariants.py \
+    --baseline pilosa_tpu/analysis/baseline.json || exit $?
+
+echo "== analysis lane: lock tracer (PILOSA_TPU_LOCKCHECK=1) =="
+# Dynamic half: the sched/cache/cluster-batch/recovery suites re-run
+# with every tracked lock feeding the acquisition-order graph; the
+# conftest audit fixture fails any test that records a lock-order cycle
+# or a lock held across device dispatch / blocking socket I/O.
+PILOSA_TPU_LOCKCHECK=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_sched.py tests/test_cache.py \
+    tests/test_cluster_batch.py tests/test_recovery.py \
+    tests/test_locktrace.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
 echo "== cache determinism gate (PYTHONHASHSEED=0 / 1) =="
 for seed in 0 1; do
     PYTHONHASHSEED=$seed JAX_PLATFORMS=cpu \

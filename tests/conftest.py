@@ -40,6 +40,27 @@ def _budget_leak_audit():
 
 
 @pytest.fixture(autouse=True)
+def _lock_discipline_audit():
+    """Lock-tracer audit (scripts/tier1.sh analysis lane sets
+    PILOSA_TPU_LOCKCHECK=1): after every test the process-wide lock
+    tracer must show zero NEW violations — a lock-order cycle or a lock
+    held across device dispatch / blocking I/O is a latent deadlock no
+    matter which test's interleaving exposed it, and failing the test
+    that CREATED the edge points straight at the offending call path."""
+    from pilosa_tpu.analysis import locktrace
+
+    reg = locktrace.ACTIVE
+    before = len(reg.violations()) if reg is not None else 0
+    yield
+    if reg is None or reg is not locktrace.ACTIVE:
+        return
+    fresh = reg.violations()[before:]
+    assert not fresh, (
+        "lock-discipline violations recorded during this test: "
+        + "; ".join(v["message"] for v in fresh))
+
+
+@pytest.fixture(autouse=True)
 def _span_leak_audit():
     """Tracing-lane leak check (scripts/tier1.sh sets PILOSA_TPU_TRACE=1):
     after every test the main thread's span scope must be empty — a span

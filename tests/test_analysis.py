@@ -1,0 +1,377 @@
+"""Project-invariant linter (analysis/lint.py): one positive + one
+negative fixture per rule, baseline suppression round-trip, and the
+scripts/lint_invariants.py CLI incl. --selftest (satellite)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pilosa_tpu.analysis import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "scripts", "lint_invariants.py")
+
+
+def _check(path, src):
+    return lint.default_engine().check_source(path, textwrap.dedent(src))
+
+
+def _rules(path, src):
+    return [v.rule for v in _check(path, src)]
+
+
+# -- no-raw-time ------------------------------------------------------------
+
+
+def test_raw_time_flagged_in_clock_module():
+    vs = _check("pilosa_tpu/sched/thing.py", """
+        import time
+        def age(t0):
+            return time.monotonic() - t0
+    """)
+    assert [v.rule for v in vs] == ["no-raw-time"]
+    assert "time.monotonic()" in vs[0].match
+
+
+def test_raw_time_clean_cases():
+    # injectable clock call: clean
+    assert _rules("pilosa_tpu/obs/thing.py", """
+        def age(clock, t0):
+            return clock.now() - t0
+    """) == []
+    # *Clock classes ARE the injectable defaults: exempt
+    assert _rules("pilosa_tpu/obs/thing.py", """
+        import time
+        class WallClock:
+            def now(self):
+                return time.monotonic()
+    """) == []
+    # out-of-scope module (core/ takes no injectable clocks): clean
+    assert _rules("pilosa_tpu/core/thing.py", """
+        import time
+        def stamp():
+            return time.time()
+    """) == []
+
+
+# -- no-bare-lock -----------------------------------------------------------
+
+
+def test_bare_lock_flagged_in_migrated_package():
+    src = """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+    """
+    assert _rules("pilosa_tpu/storage/thing.py", src) == ["no-bare-lock"]
+
+
+def test_tracked_lock_and_unmigrated_package_clean():
+    assert _rules("pilosa_tpu/cluster/thing.py", """
+        from pilosa_tpu.analysis import locktrace
+        LOCK = locktrace.tracked_lock("cluster.thing")
+    """) == []
+    # core/ is not migrated (holder.write_lock is held across dispatch
+    # by design): bare locks allowed there
+    assert _rules("pilosa_tpu/core/thing.py", """
+        import threading
+        LOCK = threading.Lock()
+    """) == []
+
+
+# -- no-callback-under-lock -------------------------------------------------
+
+
+def test_listener_loop_under_lock_flagged():
+    vs = _check("pilosa_tpu/cluster/thing.py", """
+        class C:
+            def fire(self):
+                with self._lock:
+                    for listener in self._listeners:
+                        listener(1, 2)
+    """)
+    assert [v.rule for v in vs] == ["no-callback-under-lock"]
+
+
+def test_collect_then_fire_outside_lock_clean():
+    assert _rules("pilosa_tpu/cluster/thing.py", """
+        class C:
+            def fire(self):
+                with self._lock:
+                    pending = list(self._listeners)
+                for fn in pending:
+                    fn(1, 2)
+    """) == []
+
+
+def test_cv_notify_under_lock_is_not_flagged():
+    # Condition.notify_all MUST run under the lock; flagging it would
+    # teach people to ignore the rule
+    assert _rules("pilosa_tpu/cluster/thing.py", """
+        class C:
+            def wake(self):
+                with self._lock:
+                    self._cv.notify_all()
+    """) == []
+
+
+def test_on_hook_call_under_lock_flagged():
+    vs = _check("pilosa_tpu/obs/thing.py", """
+        class C:
+            def bump(self):
+                with self.state_lock:
+                    self.on_transition("a", "b")
+    """)
+    assert [v.rule for v in vs] == ["no-callback-under-lock"]
+
+
+# -- no-device-call-outside-platform ----------------------------------------
+
+
+def test_jnp_outside_device_layer_flagged():
+    vs = _check("pilosa_tpu/stream/thing.py", """
+        import jax
+        import jax.numpy as jnp
+        def f(x):
+            y = jnp.sum(x)
+            return jax.device_put(y)
+    """)
+    assert sorted(v.rule for v in vs) == [
+        "no-device-call-outside-platform"] * 2
+
+
+def test_device_layer_and_platform_helpers_clean():
+    src = """
+        import jax.numpy as jnp
+        def kernel(x):
+            return jnp.bitwise_and(x, x)
+    """
+    assert _rules("pilosa_tpu/ops/thing.py", src) == []
+    assert _rules("pilosa_tpu/stream/thing.py", """
+        from pilosa_tpu import platform
+        def stage(host):
+            return platform.h2d_copy(host)
+    """) == []
+
+
+# -- contextvar-set-reset ---------------------------------------------------
+
+
+def test_discarded_contextvar_token_flagged():
+    vs = _check("pilosa_tpu/obs/thing.py", """
+        import contextvars
+        CV = contextvars.ContextVar("cv")
+        def enter(v):
+            CV.set(v)
+    """)
+    assert [v.rule for v in vs] == ["contextvar-set-reset"]
+
+
+def test_kept_token_never_reset_flagged():
+    vs = _check("pilosa_tpu/obs/thing.py", """
+        import contextvars
+        CV = contextvars.ContextVar("cv")
+        def enter(v):
+            token = CV.set(v)
+            return 7
+    """)
+    assert [v.rule for v in vs] == ["contextvar-set-reset"]
+
+
+def test_paired_or_escaping_token_clean():
+    assert _rules("pilosa_tpu/obs/thing.py", """
+        import contextvars
+        CV = contextvars.ContextVar("cv")
+        def scoped(v):
+            token = CV.set(v)
+            try:
+                pass
+            finally:
+                CV.reset(token)
+    """) == []
+    # returning the token hands reset responsibility to the caller
+    assert _rules("pilosa_tpu/obs/thing.py", """
+        import contextvars
+        CV = contextvars.ContextVar("cv")
+        def enter(v):
+            token = CV.set(v)
+            return token
+    """) == []
+
+
+# -- metrics-label-hygiene --------------------------------------------------
+
+
+def test_computed_label_value_flagged():
+    vs = _check("pilosa_tpu/server/thing.py", """
+        def rec(registry, shard):
+            registry.count("reads_total", shard=f"shard-{shard}")
+    """)
+    assert [v.rule for v in vs] == ["metrics-label-hygiene"]
+    vs = _check("pilosa_tpu/server/thing.py", """
+        def rec(registry, node):
+            registry.gauge("state", 1.0, node=str(node))
+    """)
+    assert [v.rule for v in vs] == ["metrics-label-hygiene"]
+
+
+def test_bounded_label_value_clean():
+    assert _rules("pilosa_tpu/server/thing.py", """
+        def rec(registry, outcome, n):
+            registry.count("reads_total", n, outcome=outcome)
+            registry.observe("latency_seconds", 0.5, op="query")
+    """) == []
+
+
+# -- engine + baseline ------------------------------------------------------
+
+
+def test_parse_error_is_reported_not_raised():
+    vs = _check("pilosa_tpu/obs/broken.py", "def f(:\n")
+    assert [v.rule for v in vs] == ["parse-error"]
+
+
+def test_violation_key_survives_line_churn():
+    src = """
+        import time
+        def age(t0):
+            return time.monotonic() - t0
+    """
+    v1 = _check("pilosa_tpu/sched/thing.py", src)[0]
+    v2 = _check("pilosa_tpu/sched/thing.py", "# a new header comment\n"
+                + textwrap.dedent(src))[0]
+    assert v1.line != v2.line
+    assert v1.key() == v2.key()  # baseline still matches
+
+
+def test_baseline_round_trip(tmp_path):
+    vs = _check("pilosa_tpu/sched/thing.py", """
+        import time
+        def age(t0):
+            return time.monotonic() - t0
+    """)
+    entries = lint.baseline_entries_for(vs, reason="known real-time spin")
+    path = str(tmp_path / "baseline.json")
+    lint.save_baseline(path, entries)
+    loaded = lint.load_baseline(path)
+    assert loaded == sorted(entries, key=lambda e: (e["rule"], e["path"],
+                                                    e["match"]))
+    new, suppressed, stale = lint.apply_baseline(vs, loaded)
+    assert new == [] and len(suppressed) == len(vs) and stale == []
+    # ratchet: an entry whose site was fixed shows up stale
+    extra = loaded + [{"rule": "no-raw-time", "path": "gone.py",
+                       "match": "time.time()", "reason": "fixed"}]
+    new, _, stale = lint.apply_baseline(vs, extra)
+    assert new == [] and len(stale) == 1
+    # and a violation NOT in the baseline stays new
+    other = _check("pilosa_tpu/cache/thing.py",
+                   "import threading\nL = threading.Lock()\n")
+    new, _, _ = lint.apply_baseline(other, loaded)
+    assert [v.rule for v in new] == ["no-bare-lock"]
+
+
+def test_baseline_entry_requires_reason(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"entries": [
+        {"rule": "no-raw-time", "path": "x.py", "match": "time.time()"}
+    ]}))
+    with pytest.raises(ValueError, match="reason"):
+        lint.load_baseline(str(p))
+
+
+def test_check_tree_walks_and_reports_relative_paths(tmp_path):
+    pkg = tmp_path / "pilosa_tpu" / "sched"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text("import time\nT = time.time()\n")
+    (pkg / "good.py").write_text("def f(clock):\n    return clock.now()\n")
+    vs = lint.default_engine().check_tree(str(tmp_path),
+                                         rel_to=str(tmp_path))
+    assert [(v.rule, v.path) for v in vs] == [
+        ("no-raw-time", "pilosa_tpu/sched/bad.py")]
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, CLI, *args], cwd=cwd,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_cli_selftest_passes():
+    r = _run_cli("--selftest")
+    assert r.returncode == 0, r.stderr
+    assert "selftest OK" in r.stdout
+
+
+def test_cli_exits_nonzero_on_seeded_violation_each_category(tmp_path):
+    seeds = {
+        "pilosa_tpu/sched/a.py": "import time\nT = time.time()\n",
+        "pilosa_tpu/cache/b.py": "import threading\nL = threading.Lock()\n",
+        "pilosa_tpu/cluster/c.py": (
+            "def f(self):\n    with self._lock:\n"
+            "        for listener in self._listeners:\n"
+            "            listener()\n"),
+        "pilosa_tpu/stream/d.py": (
+            "import jax.numpy as jnp\ndef f(x):\n    return jnp.sum(x)\n"),
+        "pilosa_tpu/obs/e.py": (
+            "import contextvars\nCV = contextvars.ContextVar('cv')\n"
+            "def f(v):\n    CV.set(v)\n"),
+        "pilosa_tpu/server/f.py": (
+            "def f(registry, s):\n"
+            "    registry.count('x_total', shard=f's{s}')\n"),
+    }
+    expect = ["no-raw-time", "no-bare-lock", "no-callback-under-lock",
+              "no-device-call-outside-platform", "contextvar-set-reset",
+              "metrics-label-hygiene"]
+    for (rel, src), rule in zip(seeds.items(), expect):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        r = _run_cli(str(p), "--baseline", "-")
+        assert r.returncode == 1, (rel, r.stdout, r.stderr)
+        assert rule in r.stdout, (rule, r.stdout)
+
+
+def test_cli_zero_on_shipped_tree_with_baseline():
+    r = _run_cli("pilosa_tpu", "--baseline",
+                 os.path.join("pilosa_tpu", "analysis", "baseline.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout and "0 stale" in r.stdout
+
+
+def test_cli_json_output(tmp_path):
+    p = tmp_path / "pilosa_tpu" / "sched" / "a.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import time\nT = time.time()\n")
+    r = _run_cli(str(p), "--baseline", "-", "--json")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert [v["rule"] for v in doc["new"]] == ["no-raw-time"]
+    assert doc["suppressed"] == [] and doc["stale_baseline_entries"] == []
+
+
+def test_cli_write_baseline_then_green(tmp_path):
+    p = tmp_path / "pilosa_tpu" / "sched" / "a.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import time\nT = time.time()\n")
+    bl = str(tmp_path / "baseline.json")
+    r = _run_cli(str(p), "--baseline", bl, "--write-baseline")
+    assert r.returncode == 0, r.stderr
+    r = _run_cli(str(p), "--baseline", bl)
+    assert r.returncode == 0, r.stdout
+    assert "1 baselined" in r.stdout
+
+
+def test_cli_list_rules():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in ("no-raw-time", "no-bare-lock", "no-callback-under-lock",
+                 "no-device-call-outside-platform", "contextvar-set-reset",
+                 "metrics-label-hygiene"):
+        assert rule in r.stdout

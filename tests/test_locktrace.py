@@ -1,0 +1,286 @@
+"""Lock tracer (analysis/locktrace.py): disabled-path zero overhead,
+order-graph cycles, dispatch/io boundary checks, Condition compat, and
+the breaker-listener fires-outside-the-lock regression (satellite: the
+health-plane deadlock shape, asserted with held-locks introspection)."""
+
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.analysis import locktrace
+from pilosa_tpu.cluster.resilience import (BREAKER_OPEN, CircuitBreaker)
+from pilosa_tpu.sched.clock import ManualClock
+
+
+def _tracked(name, reg, **kw):
+    """Wrapper bound to a PRIVATE registry: deliberate violations in
+    these tests must not land in the process-wide tracer (the conftest
+    audit fixture fails any test that records one there)."""
+    return locktrace._TrackedLock(name, reg, **kw)
+
+
+# -- disabled path ----------------------------------------------------------
+
+
+@pytest.mark.skipif(locktrace.ACTIVE is not None,
+                    reason="tracer enabled (PILOSA_TPU_LOCKCHECK lane)")
+def test_disabled_path_allocates_no_wrappers():
+    before = locktrace.WRAPPER_COUNT
+    lk = locktrace.tracked_lock("t.disabled")
+    rl = locktrace.tracked_lock("t.disabled.r", rlock=True)
+    assert locktrace.WRAPPER_COUNT == before  # bare locks, no wrapper
+    assert type(lk) is type(threading.Lock())
+    assert rl.__class__.__name__ == "RLock"
+    assert locktrace.held_locks() == []
+    assert locktrace.timeline_probe() == {"enabled": False,
+                                          "violations": 0}
+    rep = locktrace.report()
+    assert rep["enabled"] is False and rep["violations"] == []
+
+
+# -- order graph + cycles ---------------------------------------------------
+
+
+def test_nested_acquire_records_edge_and_held_stack():
+    reg = locktrace.LockTraceRegistry()
+    a, b = _tracked("A", reg), _tracked("B", reg)
+    with a:
+        assert reg.held_locks() == ["A"]
+        with b:
+            assert reg.held_locks() == ["A", "B"]
+    assert reg.held_locks() == []
+    assert reg.report()["edges"] == {"A": ["B"]}
+    assert reg.violations() == []
+
+
+def test_ab_ba_cycle_detected_without_deadlocking():
+    reg = locktrace.LockTraceRegistry()
+    a, b = _tracked("A", reg), _tracked("B", reg)
+    with a:
+        with b:
+            pass
+
+    def reversed_order():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join(5)
+    vs = reg.violations(kind=locktrace.KIND_CYCLE)
+    assert len(vs) == 1
+    assert set(vs[0]["cycle"]) == {"A", "B"}
+    # the same cycle observed again dedups
+    with a:
+        with b:
+            pass
+    assert len(reg.violations(kind=locktrace.KIND_CYCLE)) == 1
+
+
+def test_three_lock_cycle_reports_full_path():
+    reg = locktrace.LockTraceRegistry()
+    a, b, c = _tracked("A", reg), _tracked("B", reg), _tracked("C", reg)
+    for outer, inner in ((a, b), (b, c)):
+        with outer:
+            with inner:
+                pass
+    with c:
+        with a:  # closes C -> A, cycle A -> B -> C -> A
+            pass
+    vs = reg.violations(kind=locktrace.KIND_CYCLE)
+    assert len(vs) == 1
+    assert set(vs[0]["cycle"]) == {"A", "B", "C"}
+
+
+def test_rlock_reentry_records_one_stack_entry():
+    reg = locktrace.LockTraceRegistry()
+    r = _tracked("R", reg, rlock=True)
+    with r:
+        with r:
+            assert reg.held_locks() == ["R"]
+        assert reg.held_locks() == ["R"]
+    assert reg.held_locks() == []
+
+
+def test_condition_wrapping_keeps_bookkeeping_consistent():
+    reg = locktrace.LockTraceRegistry()
+    lk = _tracked("CV", reg)
+    cv = threading.Condition(lk)
+    held_after_wait = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            held_after_wait.append(reg.held_locks())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    # wait()'s release/re-acquire round trip restored the held stack
+    assert held_after_wait == [["CV"]]
+    assert reg.held_locks() == []
+    assert reg.violations() == []
+
+
+# -- blocking-boundary checks -----------------------------------------------
+
+
+def test_dispatch_with_lock_held_is_flagged():
+    reg = locktrace.LockTraceRegistry()
+    lk = _tracked("holder", reg)
+    with lk:
+        reg.note_dispatch("platform.guarded_call")
+    vs = reg.violations(kind=locktrace.KIND_DISPATCH)
+    assert len(vs) == 1 and vs[0]["locks"] == ["holder"]
+    # dedup: same locks at the same site report once
+    with lk:
+        reg.note_dispatch("platform.guarded_call")
+    assert len(reg.violations(kind=locktrace.KIND_DISPATCH)) == 1
+
+
+def test_dispatch_ok_lock_is_exempt():
+    reg = locktrace.LockTraceRegistry()
+    guard = _tracked("dispatch", reg, rlock=True, dispatch_ok=True)
+    with guard:
+        reg.note_dispatch("platform.guarded_call")
+    assert reg.violations() == []
+    reg.note_dispatch("platform.guarded_call")  # nothing held: clean
+    assert reg.violations() == []
+
+
+def test_io_with_lock_held_is_flagged_unless_io_ok():
+    reg = locktrace.LockTraceRegistry()
+    lk = _tracked("plain", reg)
+    ok = _tracked("outboxish", reg, io_ok=True)
+    with ok:
+        reg.note_io("cluster.client._request")
+    assert reg.violations() == []
+    with lk:
+        reg.note_io("cluster.client._request")
+    vs = reg.violations(kind=locktrace.KIND_IO)
+    assert len(vs) == 1 and vs[0]["locks"] == ["plain"]
+
+
+def test_violation_counts_metric():
+    from pilosa_tpu.obs.metrics import METRIC_LOCK_VIOLATIONS, REGISTRY
+
+    reg = locktrace.LockTraceRegistry()
+    lk = _tracked("metered", reg)
+    before = REGISTRY.value(METRIC_LOCK_VIOLATIONS,
+                            kind=locktrace.KIND_DISPATCH)
+    with lk:
+        reg.note_dispatch("site")
+    after = REGISTRY.value(METRIC_LOCK_VIOLATIONS,
+                           kind=locktrace.KIND_DISPATCH)
+    assert after == before + 1
+
+
+def test_violation_ring_is_bounded():
+    reg = locktrace.LockTraceRegistry()
+    lk = _tracked("cap", reg)
+    with lk:
+        for i in range(locktrace.VIOLATION_CAP + 50):
+            reg.note_dispatch(f"site-{i}")  # distinct keys: no dedup
+    assert len(reg.violations()) == locktrace.VIOLATION_CAP
+
+
+def test_report_and_probe_shapes():
+    reg = locktrace.LockTraceRegistry()
+    a, b = _tracked("A", reg), _tracked("B", reg)
+    with a:
+        with b:
+            pass
+    rep = reg.report()
+    assert rep["enabled"] is True
+    assert rep["locks"] == {"A": 1, "B": 1}
+    assert rep["edges"] == {"A": ["B"]}
+    probe = reg.timeline_probe()
+    assert probe == {"enabled": True, "violations": 0, "cycles": 0,
+                     "edges": 1}
+
+
+# -- breaker listeners fire outside the lock (satellite) --------------------
+
+
+@pytest.fixture
+def global_tracer():
+    """The process-wide tracer, enabling it for this test if the lane
+    env didn't already (the breaker's own lock must be created tracked
+    for held-locks introspection to see it)."""
+    was_on = locktrace.ACTIVE is not None
+    reg = locktrace.enable()
+    yield reg
+    if not was_on:
+        locktrace.disable()
+
+
+def test_breaker_listener_fires_outside_breaker_lock(global_tracer):
+    """Deterministic two-thread interleaving of the health-plane
+    deadlock shape: while a transition listener is STILL RUNNING (held
+    open on an event), a second thread must be able to read breaker
+    state — impossible if the listener were invoked under the breaker
+    lock — and the tracer's held-locks stack inside the listener must be
+    empty."""
+    clock = ManualClock()
+    breaker = CircuitBreaker(threshold=1, open_s=3.0, clock=clock)
+    in_listener = threading.Event()
+    release_listener = threading.Event()
+    seen = {}
+
+    def listener(node_id, frm, to):
+        seen["held"] = locktrace.held_locks()
+        seen["transition"] = (node_id, frm, to)
+        in_listener.set()
+        assert release_listener.wait(5), "test never released listener"
+
+    breaker.add_listener(listener)
+
+    t1 = threading.Thread(target=breaker.record_failure, args=("n1",))
+    t1.start()
+    assert in_listener.wait(5), "listener never fired"
+
+    # interleave: a second thread reads state WHILE the listener blocks
+    got = {}
+
+    def reader():
+        got["state"] = breaker.state("n1")
+
+    t2 = threading.Thread(target=reader)
+    t2.start()
+    t2.join(5)
+    assert not t2.is_alive(), \
+        "state() blocked while a listener was in flight: listener runs " \
+        "under the breaker lock"
+    assert got["state"] == BREAKER_OPEN
+
+    release_listener.set()
+    t1.join(5)
+    assert seen["transition"] == ("n1", "closed", "open")
+    assert seen["held"] == [], \
+        f"breaker lock held while listener ran: {seen['held']}"
+
+
+def test_breaker_on_transition_hook_outside_lock(global_tracer):
+    """Same contract for the constructor's on_transition hook, across a
+    full open -> half-open -> closed walk (allow + record_success paths
+    fire it too, not just record_failure)."""
+    clock = ManualClock()
+    held_per_event = []
+
+    def hook(node_id, frm, to):
+        held_per_event.append((to, locktrace.held_locks()))
+
+    breaker = CircuitBreaker(threshold=1, open_s=1.0, clock=clock,
+                             on_transition=hook)
+    breaker.record_failure("n2")
+    clock.advance(1.5)
+    assert breaker.allow("n2")       # grants half-open probe
+    breaker.record_success("n2")     # closes
+    assert [e[0] for e in held_per_event] == ["open", "half-open",
+                                              "closed"]
+    assert all(held == [] for _, held in held_per_event), held_per_event
