@@ -147,7 +147,8 @@ class NoRawTimeRule(Rule):
                    "instead; *Clock classes are the injectable defaults "
                    "and are exempt)")
     scopes = ("pilosa_tpu/sched/", "pilosa_tpu/obs/", "pilosa_tpu/gossip/",
-              "pilosa_tpu/stream/", "pilosa_tpu/transaction.py")
+              "pilosa_tpu/stream/", "pilosa_tpu/dax/",
+              "pilosa_tpu/transaction.py")
 
     def check(self, path, tree, source):
         out: List[Violation] = []
@@ -183,7 +184,7 @@ class NoBareLockRule(Rule):
     description = ("bare threading.Lock()/RLock() in a package migrated "
                    "to analysis.locktrace.tracked_lock(name)")
     scopes = ("pilosa_tpu/sched/", "pilosa_tpu/cache/", "pilosa_tpu/cluster/",
-              "pilosa_tpu/storage/", "pilosa_tpu/obs/",
+              "pilosa_tpu/storage/", "pilosa_tpu/obs/", "pilosa_tpu/dax/",
               "pilosa_tpu/platform.py", "pilosa_tpu/analysis/")
     # the wrapper implementation hands out and uses bare locks by design
     exempt = ("analysis/locktrace.py",)

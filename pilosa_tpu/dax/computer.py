@@ -4,17 +4,28 @@ Reference: the featurebase server in compute mode — check-in loop
 (server/server.go:298), directive application (api_directive.go:21-144),
 shard state rebuilt from Snapshotter + Writelogger (dax/storage/,
 cluster.go daxstorage hooks). Every write is appended to the shared-FS
-writelog BEFORE it applies locally (the durability contract that makes
-the node stateless: kill it and the next owner replays), and logs
-compact into snapshots past an op threshold.
+writelog and GROUP-COMMITTED (one fsync per touched shard per request,
+not per op) BEFORE it applies locally and before the client is acked —
+the durability contract that makes the node stateless: kill it and the
+next owner replays exactly the acked prefix (torn tails past the last
+commit were never acknowledged).
+
+Directive handling speaks both METHOD_FULL and METHOD_DIFF: a diff whose
+``base_version`` is not our current version means we missed a push — we
+answer ``resync`` and the controller falls back to FULL. A warm handoff
+finishes shard resume (snapshot install + log-tail replay) and prewarms
+the directive's hot fields BEFORE acking, so the first queries routed
+here hit resident device planes instead of paying stack build + h2d.
 
 Serves the same /internal/* HTTP surface as a classic cluster node, so
-the Queryer talks to it through the unchanged InternalClient.
+the Queryer talks to it through the unchanged InternalClient (which also
+gives every leg trace + tenant propagation for free).
 """
 
 from __future__ import annotations
 
 import base64
+import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -24,27 +35,40 @@ from pilosa_tpu.cluster.topology import Node
 from pilosa_tpu.core.fragment import _grow_rows
 from pilosa_tpu.core.stacked import release_field_cache
 from pilosa_tpu.dax.directive import (
-    Directive, METHOD_FULL, METHOD_RESET,
+    Directive, METHOD_DIFF, METHOD_FULL, METHOD_RESET,
 )
 from pilosa_tpu.dax.storage import Snapshotter, WriteLogger
+from pilosa_tpu.obs import metrics as obs_metrics
 from pilosa_tpu.pql.executor import Executor, has_write_calls
 from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.pql.result import result_to_wire
+from pilosa_tpu.sched.clock import MonotonicClock
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 class Computer:
     def __init__(self, node_id: str, shared_dir: str, uri: str = "",
-                 snapshot_every: int = 256):
+                 snapshot_every: int = 256, *, sync: str = "batch",
+                 warm_handoff: bool = True, crash_plan=None,
+                 clock=None, registry=None):
         self.api = API()
         self.node = Node(id=node_id, uri=uri)
-        self.wl = WriteLogger(shared_dir)
-        self.snap = Snapshotter(shared_dir)
+        self.crash_plan = crash_plan
+        self.registry = registry if registry is not None \
+            else obs_metrics.REGISTRY
+        self.clock = clock if clock is not None else MonotonicClock()
+        self.wl = WriteLogger(shared_dir, sync=sync, crash_plan=crash_plan,
+                              registry=self.registry)
+        self.snap = Snapshotter(shared_dir, crash_plan=crash_plan)
         self.snapshot_every = snapshot_every
+        self.warm_handoff = warm_handoff
         self.directive_version = -1
+        self.directive_at: Optional[float] = None
         self.assigned: Set[Tuple[str, int]] = set()
         self._last_snap: Dict[Tuple[str, int], int] = {}
         self._exec = Executor(self.api.holder, remote=True)
+        # lazy InternalClient for membership ping relays (gossip plane)
+        self._relay_client = None
 
     # -- directive application (reference: api_directive.go:21) ------------
 
@@ -53,19 +77,47 @@ class Computer:
         if d.method != METHOD_RESET and d.version <= self.directive_version:
             # stale or duplicate push: reject regressions (:26-41)
             return {"version": self.directive_version, "applied": False}
-        if d.method == METHOD_RESET:
-            # wipe and reload from shared storage (:63 DirectiveMethodReset)
-            self.api = API()
-            self._exec = Executor(self.api.holder, remote=True)
-            self.assigned = set()
-        self._apply_schema(d.schema)
-        want = set(d.assigned)
-        for table, shard in sorted(self.assigned - want):
+        if d.method == METHOD_DIFF:
+            if d.base_version != self.directive_version:
+                # we missed a push — the delta doesn't apply on top of
+                # what we have; ask the controller for the full picture
+                return {"version": self.directive_version,
+                        "applied": False, "resync": True}
+            if d.schema_changed:
+                self._apply_schema(d.schema)
+            drop = sorted(set(d.remove) & self.assigned)
+            load = sorted(set(d.add) - self.assigned)
+            want = (self.assigned - set(d.remove)) | set(d.add)
+        else:
+            if d.method == METHOD_RESET:
+                # wipe and reload from shared storage (:63
+                # DirectiveMethodReset)
+                self.api = API()
+                self._exec = Executor(self.api.holder, remote=True)
+                self.assigned = set()
+                self._last_snap.clear()
+            self._apply_schema(d.schema)
+            want = set(d.assigned)
+            drop = sorted(self.assigned - want)
+            load = sorted(want - self.assigned)
+        for table, shard in drop:
             self._drop_shard(table, shard)
-        for table, shard in sorted(want - self.assigned):
+        if self.crash_plan is not None:
+            # kill point between the drop and load phases: a directive
+            # observed half-applied must rebuild cleanly on restart
+            # (nothing below has acked — the controller re-pushes)
+            if not self.crash_plan.fire("dax.directive.mid"):
+                return {"version": self.directive_version, "applied": False}
+        for table, shard in load:
             self._load_shard(table, shard)
+        if self.warm_handoff and load:
+            # build device planes for the hot fields BEFORE advertising
+            # ready: the ack below is what lets the controller route
+            # queries here, so everything after it is on the serving path
+            self._prewarm(d.hot, {t for t, _ in load})
         self.assigned = want
         self.directive_version = d.version
+        self.directive_at = self.clock.now()
         return {"version": d.version, "applied": True}
 
     def _apply_schema(self, schema: List[dict]) -> None:
@@ -94,14 +146,47 @@ class Computer:
             field.bsi.pop(shard, None)
             release_field_cache(field)
 
+    def _prewarm(self, hot: List[Tuple[str, str]],
+                 tables: Set[str]) -> None:
+        """Warm handoff: pin stacked device planes for the directive's
+        hot fields on the tables we just took over. Fields the schema
+        no longer has (or whose table we don't own) are skipped — the
+        hot list is advisory, never an error source."""
+        from pilosa_tpu.core.stacked import stacked_bsi, stacked_set
+
+        built = 0
+        for table, fname in hot:
+            if table not in tables:
+                continue
+            idx = self.api.holder.indexes.get(table)
+            if idx is None:
+                continue
+            field = idx.fields.get(fname)
+            if field is None:
+                continue
+            shard_list = sorted(idx.shards())
+            if not shard_list:
+                continue
+            for view in sorted(field.views):
+                stacked_set(field, shard_list, view)
+                built += 1
+            if field.bsi:
+                stacked_bsi(field, shard_list)
+                built += 1
+        if built:
+            self.registry.count(obs_metrics.METRIC_DAX_PREWARM_STACKS,
+                                built)
+
     # -- shard resume: snapshot + log replay (reference: dax/storage/) -----
 
     def _load_shard(self, table: str, shard: int) -> None:
+        t0 = time.perf_counter()
         from_version = 0
         latest = self.snap.latest(table, shard)
         if latest is not None:
             from_version, arrays = latest
             self._install_snapshot(table, shard, arrays)
+        replayed = 0
         for op in self.wl.replay(table, shard, from_version):
             # Replay is total: an op that fails application (it failed
             # identically for its original client) must not wedge the
@@ -114,6 +199,13 @@ class Computer:
                 logging.getLogger("pilosa_tpu.dax").warning(
                     "writelog replay skipped bad op on %s/%d: %r",
                     table, shard, exc)
+            replayed += 1
+        if replayed:
+            self.registry.count(obs_metrics.METRIC_DAX_REPLAY_OPS,
+                                replayed)
+        self.registry.observe_bucketed(
+            obs_metrics.METRIC_DAX_REPLAY_SECONDS,
+            time.perf_counter() - t0, obs_metrics.DAX_REPLAY_BUCKETS)
 
     def _export_shard(self, table: str, shard: int) -> Dict[str, np.ndarray]:
         from pilosa_tpu.storage.store import export_shard_arrays
@@ -150,7 +242,8 @@ class Computer:
         """Compaction trigger: snapshot once the log has grown
         snapshot_every ops past the last snapshot (an exact-multiple
         check would skip forever when multi-op requests stride past the
-        boundary)."""
+        boundary). A successful snapshot prunes the log segments it
+        covers — the snapshot now protects those ops."""
         n = self.wl.length(table, shard)
         key = (table, shard)
         last = self._last_snap.get(key)
@@ -158,8 +251,10 @@ class Computer:
             last = self.snap.latest_version(table, shard)
             self._last_snap[key] = last
         if n - last >= self.snapshot_every:
-            self.snap.write(table, shard, n, self._export_shard(table, shard))
-            self._last_snap[key] = n
+            if self.snap.write(table, shard, n,
+                               self._export_shard(table, shard)):
+                self.wl.prune(table, shard, n)
+                self._last_snap[key] = n
 
     # -- internal serving surface (same shape as ClusterNode) --------------
 
@@ -181,8 +276,12 @@ class Computer:
                     self.wl.append(index, s, {"k": "pql",
                                               "q": inner.to_pql()})
                     touched.add(s)
+            # group commit: ONE fsync per touched shard for the whole
+            # request, before any op applies or the client is acked
+            for s in sorted(touched):
+                self.wl.commit(index, s)
         results = self._exec.execute(index, q, shards=shards)
-        for s in touched:
+        for s in sorted(touched):
             self.maybe_snapshot(index, s)
         return [result_to_wire(r) for r in results]
 
@@ -201,11 +300,14 @@ class Computer:
             ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
             ent[0].append(int(r))
             ent[1].append(int(c))
-        total = 0
         for shard, (rs, cs) in sorted(by_shard.items()):
             self.wl.append(index, shard,
                            {"k": "bits", "f": field, "r": rs, "c": cs,
                             "x": int(clear)})
+        for shard in sorted(by_shard):
+            self.wl.commit(index, shard)
+        total = 0
+        for shard, (rs, cs) in sorted(by_shard.items()):
             total += self.api.import_bits(index, field, rows=rs, cols=cs,
                                           clear=clear)
             self.maybe_snapshot(index, shard)
@@ -228,10 +330,13 @@ class Computer:
             ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
             ent[0].append(int(c))
             ent[1].append(v)
-        total = 0
         for shard, (cs, vs) in sorted(by_shard.items()):
             self.wl.append(index, shard,
                            {"k": "vals", "f": field, "c": cs, "v": vs})
+        for shard in sorted(by_shard):
+            self.wl.commit(index, shard)
+        total = 0
+        for shard, (cs, vs) in sorted(by_shard.items()):
             total += self.api.import_values(index, field, cols=cs, values=vs)
             self.maybe_snapshot(index, shard)
         return total
@@ -243,8 +348,31 @@ class Computer:
             "k": "roaring", "f": field, "s": shard, "x": int(clear),
             "views": {v: base64.b64encode(b).decode()
                       for v, b in views.items()}})
+        self.wl.commit(index, shard)
         self.api.import_roaring(index, field, shard, views, clear=clear)
         self.maybe_snapshot(index, shard)
+
+    # -- membership surface (gossip/membership.py probes us like any node) -
+
+    def membership_ping(self, body: dict) -> dict:
+        target = body.get("target")
+        if target:
+            # indirect probe relay: ping the target on the requester's
+            # behalf and report what WE saw (SWIM's ping-req leg)
+            if self._relay_client is None:
+                from pilosa_tpu.cluster.client import InternalClient
+
+                self._relay_client = InternalClient()
+            node = Node(id=target["id"], uri=target.get("uri", ""))
+            try:
+                return self._relay_client.membership_ping(node, {})
+            except Exception:
+                return {"ok": False, "node": self.node.id}
+        return {"ok": True, "node": self.node.id,
+                "inc": int(body.get("inc", 0))}
+
+    def membership_json(self) -> dict:
+        return {"node": self.node.id, "view": {}}
 
     # -- passthroughs so the stock HTTP handler can serve a computer -------
 
@@ -276,6 +404,13 @@ class Computer:
         return self.api.schema()
 
     def status(self) -> dict:
+        age = (self.clock.now() - self.directive_at
+               if self.directive_at is not None else -1.0)
         return {"nodeID": self.node.id,
                 "directiveVersion": self.directive_version,
+                "directiveAgeS": age,
+                "ready": self.directive_version >= 0,
                 "assigned": sorted([t, s] for t, s in self.assigned)}
+
+    def close(self) -> None:
+        self.wl.close()

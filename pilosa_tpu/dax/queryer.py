@@ -6,10 +6,22 @@ the etcd snapshot (Topologer :43). Here the fork is free: the classic
 ClusterExecutor takes its topology through a snapshot function, so the
 Queryer feeds it a controller-backed snapshot and reuses the whole
 fan-out/reduce/translate machinery.
+
+``enable_serving`` upgrades the front-end to production shape: reads
+route through the QueryScheduler's bounded admission (micro-batching +
+deadline shedding) and a ResultCache keyed on the directive version —
+any reassignment invalidates every cached result wholesale, so a stale
+owner can never serve from cache. Every remote leg already carries
+tenant + trace context (the InternalClient attaches both headers on
+each request), so the serving plane composes with the attribution and
+tracing planes with no code here. Queried field names feed back to the
+controller (``note_hot``) — the warm-handoff prewarm set.
 """
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pilosa_tpu.cluster.client import InternalClient
@@ -17,6 +29,7 @@ from pilosa_tpu.cluster.executor import ClusterExecutor
 from pilosa_tpu.cluster.topology import ClusterSnapshot, Node
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.pql.executor import has_write_calls
 from pilosa_tpu.pql.parser import parse
 from pilosa_tpu.pql.result import result_to_json
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -50,6 +63,34 @@ class Queryer:
             "queryer", self.holder, self.client, self._snapshot,
             controller.shards_of,
             live_fn=controller.live_ids)
+        self.scheduler = None
+        self.cache = None
+        # recent end-to-end read latencies (ms) — the autoscaler's p99
+        self._lat: deque = deque(maxlen=128)
+        # bumped on every write routed through THIS front-end and mixed
+        # into cache keys: read-your-writes through one queryer (other
+        # front-ends converge at directive bumps / TTL, like any
+        # stateless serving tier)
+        self._write_epoch = 0
+
+    def enable_serving(self, scheduler=None, cache=None, config=None,
+                       clock=None, **sched_kw):
+        """Production serving shape: reads go through scheduler
+        admission and a directive-versioned result cache. Off by
+        default — the plain Queryer stays zero-cost (no worker thread,
+        no cache memory)."""
+        from pilosa_tpu.cache.result_cache import ResultCache
+        from pilosa_tpu.sched.scheduler import QueryScheduler
+
+        self.cache = cache if cache is not None \
+            else ResultCache.from_config(config)
+        self.scheduler = scheduler if scheduler is not None \
+            else QueryScheduler(self.executor, clock=clock, **sched_kw)
+        return self
+
+    def close(self) -> None:
+        if self.scheduler is not None:
+            self.scheduler.close()
 
     def _snapshot(self) -> DaxSnapshot:
         return DaxSnapshot(self.controller.live_nodes(),
@@ -112,7 +153,60 @@ class Queryer:
                     col = ids.get(col)
                 if isinstance(col, int):
                     self.controller.ensure_shard(index, col // SHARD_WIDTH)
-        return self.executor.execute(index, q, shards=shards)
+        self._note_hot(index, q.calls)
+        if has_write_calls(q):
+            self._write_epoch += 1
+        if self.scheduler is not None and not has_write_calls(q):
+            # serving path: cache keyed on the directive version — any
+            # reassignment bumps the version and invalidates wholesale,
+            # then bounded admission + micro-batching under it
+            t0 = time.perf_counter()
+            key = ("dax", index, pql,
+                   tuple(sorted(shards)) if shards is not None else None,
+                   self.controller.version, self._write_epoch)
+            out = self.cache.run(
+                key,
+                lambda: self.scheduler.submit(index, q,
+                                              shards=shards).result())
+            self._lat.append((time.perf_counter() - t0) * 1e3)
+            return out
+        t0 = time.perf_counter()
+        out = self.executor.execute(index, q, shards=shards)
+        self._lat.append((time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _note_hot(self, index: str, calls) -> None:
+        """Feed queried field names back to the controller — the
+        prewarm set a future owner of these shards will build before
+        advertising ready."""
+        for call in calls:
+            try:
+                pair = call.field_arg()
+            except Exception:
+                pair = None
+            if pair is not None and isinstance(pair[0], str):
+                self.controller.note_hot(index, pair[0])
+            fname = call.arg("field") if hasattr(call, "arg") else None
+            if isinstance(fname, str):
+                self.controller.note_hot(index, fname)
+            self._note_hot(index, getattr(call, "children", []) or [])
+
+    def probe(self) -> dict:
+        """Timeline probe fragment: serving pressure (what the
+        autoscaler reads) plus cache shape."""
+        lat = sorted(self._lat)
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
+        out = {
+            "queue_depth": (self.scheduler.queue_depth()
+                            if self.scheduler is not None else 0),
+            "leg_p99_ms": p99,
+            "serving": self.scheduler is not None,
+        }
+        if self.cache is not None:
+            st = self.cache.stats()
+            out["cache_hits"] = st.get("hits", 0)
+            out["cache_misses"] = st.get("misses", 0)
+        return out
 
     def query_json(self, index: str, pql: str) -> dict:
         return {"results": [result_to_json(r)
@@ -123,6 +217,7 @@ class Queryer:
     def import_bits(self, index: str, field: str, rows=None, cols=None,
                     clear: bool = False) -> int:
         self._sync_schema()
+        self._write_epoch += 1
         by_shard: Dict[int, Tuple[list, list]] = {}
         for r, c in zip(rows or [], cols or []):
             ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))
@@ -140,6 +235,7 @@ class Queryer:
     def import_values(self, index: str, field: str, cols=None,
                       values=None) -> int:
         self._sync_schema()
+        self._write_epoch += 1
         by_shard: Dict[int, Tuple[list, list]] = {}
         for c, v in zip(cols or [], values or []):
             ent = by_shard.setdefault(int(c) // SHARD_WIDTH, ([], []))

@@ -25,6 +25,10 @@ triggers
                       lock-order cycle or a lock held across device
                       dispatch / blocking I/O (analysis/locktrace.py;
                       only fires under PILOSA_TPU_LOCKCHECK=1)
+- ``directive_churn`` the DAX control plane bumped the directive
+                      version past the threshold inside the probe
+                      window — assignment thrash from a flapping
+                      computer or a rebalance loop (dax/controller.py)
 
 bundle contents: the trailing timeline window, SLO status, slow traces
 from the trace store (IDs resolve at /internal/traces/{id}), the
@@ -64,6 +68,7 @@ class FlightRecorder:
                  ingest_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
                  flap_transitions: float = 6.0,
+                 directive_churn_bumps: float = 8.0,
                  dump_dir: str = "",
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  clock=None):
@@ -74,6 +79,7 @@ class FlightRecorder:
         self.ingest_stall_s = float(ingest_stall_s)
         self.slow_burst_per_s = float(slow_burst_per_s)
         self.flap_transitions = float(flap_transitions)
+        self.directive_churn_bumps = float(directive_churn_bumps)
         self.dump_dir = dump_dir or ""
         self.registry = registry or obs_metrics.REGISTRY
         self.clock = clock or WallClock()
@@ -203,6 +209,19 @@ class FlightRecorder:
                 b = self.trigger(
                     "membership_flap",
                     f"{flaps} membership transitions in window", sample)
+                if b:
+                    fired.append(b)
+
+        dax = probes.get("dax")
+        if isinstance(dax, dict):
+            bumps = dax.get("recent_directive_bumps", 0) or 0
+            if bumps >= self.directive_churn_bumps:
+                # a control plane rewriting the assignment this fast is
+                # thrashing (flapping node, rebalance loop) — capture
+                # before the churn's cause ages out of the ring
+                b = self.trigger(
+                    "directive_churn",
+                    f"{bumps} directive bumps in window", sample)
                 if b:
                     fired.append(b)
 

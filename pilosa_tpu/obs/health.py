@@ -97,6 +97,7 @@ class HealthPlane:
                  ingest_stall_s: float = 5.0,
                  slow_burst_per_s: float = 5.0,
                  membership_flap_transitions: float = 6.0,
+                 directive_churn_bumps: float = 8.0,
                  dump_dir: str = "",
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
                  clock=None, node_id: str = "local"):
@@ -117,6 +118,7 @@ class HealthPlane:
             wal_stall_s=wal_stall_s, ingest_stall_s=ingest_stall_s,
             slow_burst_per_s=slow_burst_per_s,
             flap_transitions=membership_flap_transitions,
+            directive_churn_bumps=directive_churn_bumps,
             dump_dir=dump_dir, registry=self.registry, clock=self.clock)
         self.flight.bind(self)
         # the slo probe re-evaluates burn on every sample: the sample's
@@ -171,6 +173,27 @@ class HealthPlane:
         # per-tenant top-K rates ride the samples too, so flight bundles
         # capture WHICH tenant was burning during an anomaly
         self.timeline.add_probe("tenants", lambda: _tenants_probe(api))
+
+    def attach_dax(self, queryer=None, controller=None,
+                   autoscaler=None) -> None:
+        """Serverless-plane probe: the controller's directive state
+        (version, age, churn — feeds the ``directive_churn`` trigger),
+        the queryer's serving pressure (the autoscaler's inputs), and
+        the autoscaler's own decision trail, merged into one "dax"
+        timeline read."""
+
+        def dax():
+            out: dict = {"enabled": controller is not None
+                         or queryer is not None}
+            if controller is not None:
+                out.update(controller.probe())
+            if queryer is not None:
+                out.update(queryer.probe())
+            if autoscaler is not None:
+                out["autoscale"] = autoscaler.probe()
+            return out
+
+        self.timeline.add_probe("dax", dax)
 
     def attach_node(self, node) -> None:
         """Upgrade probes to the cluster node's live subsystems (the

@@ -239,6 +239,27 @@ METRIC_TENANT_TRACKED = "tenant_tracked"
 # blocking socket I/O observed by the tracer (labelled kind=), counted
 # only while PILOSA_TPU_LOCKCHECK is on
 METRIC_LOCK_VIOLATIONS = "lock_order_violations_total"
+# elastic serverless plane (dax/): directive version + seconds since the
+# last bump (staleness read), pushes by method/outcome, diff-gap FULL
+# resyncs, group-commit writelog fsync latency, writelog ops replayed on
+# warm handoff + the replay wall time, autoscaler decisions (labelled
+# direction=up|down), and stacked planes built by directive prewarm
+METRIC_DAX_DIRECTIVE_VERSION = "dax_directive_version"
+METRIC_DAX_DIRECTIVE_AGE = "dax_directive_age_seconds"
+METRIC_DAX_DIRECTIVE_PUSHES = "dax_directive_pushes_total"
+METRIC_DAX_FULL_RESYNCS = "dax_full_resyncs_total"
+METRIC_DAX_WL_APPEND_SECONDS = "dax_wl_append_seconds"  # histogram
+METRIC_DAX_REPLAY_OPS = "dax_replay_ops_total"
+METRIC_DAX_REPLAY_SECONDS = "dax_replay_seconds"  # histogram
+METRIC_DAX_AUTOSCALE_EVENTS = "dax_autoscale_events_total"
+METRIC_DAX_PREWARM_STACKS = "dax_prewarm_stacks_total"
+# a group-commit fsync on local disk is sub-ms; shared-FS tail latencies
+# reach tens of ms
+DAX_WL_APPEND_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25)
+# replaying a short tail after snapshot install is ms-scale; a cold log
+# with no snapshot spans seconds
+DAX_REPLAY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 

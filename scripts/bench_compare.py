@@ -147,6 +147,11 @@ def _selftest(threshold: float) -> int:
         "c1_ingest (cpu)":
             {"metric": "c1_ingest (cpu)", "value": 500000.0,
              "unit": "rows/s", "vs_baseline": 0.2},
+        # the elastic serverless gate's latency series rides the same
+        # ms-directed gate as every other config
+        "c19_dax_fresh_node_read_p99 (cpu)":
+            {"metric": "c19_dax_fresh_node_read_p99 (cpu)", "value": 40.0,
+             "unit": "ms", "vs_baseline": 1.2},
     }
     same = compare(base, base, threshold)
     assert same and not any(r["regressed"] for r in same), \
@@ -155,9 +160,11 @@ def _selftest(threshold: float) -> int:
     slow = {k: dict(v) for k, v in base.items()}
     slow["c13_resident_warm_p50 (cpu)"]["value"] = 12.0   # ms up 20%
     slow["c1_ingest (cpu)"]["value"] = 400000.0           # rows/s down 20%
+    slow["c19_dax_fresh_node_read_p99 (cpu)"]["value"] = 48.0  # ms up 20%
     rows = compare(base, slow, threshold)
     bad = {r["metric"] for r in rows if r["regressed"]}
-    assert bad == {"c13_resident_warm_p50", "c1_ingest"}, bad
+    assert bad == {"c13_resident_warm_p50", "c1_ingest",
+                   "c19_dax_fresh_node_read_p99"}, bad
     # a 10% drift stays under the default 15% gate
     drift = {k: dict(v) for k, v in base.items()}
     drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0

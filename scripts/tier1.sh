@@ -205,6 +205,28 @@ echo "== streaming ingest bench gate (bench.py --configs 17) =="
 # no-ingest baseline (batch admission yields: writes shed, not reads).
 JAX_PLATFORMS=cpu python bench.py --configs 17 || exit $?
 
+echo "== dax crash lane (PILOSA_TPU_CRASH_SEED=1 / 7) =="
+# The elastic serverless plane must replay to bit-identical state for
+# ANY seeded kill point: the seed draws a site/hit-count from the dax
+# tuple (wl.append / snap.replace / directive.mid), disjoint from the
+# storage AND stream sites so those lanes are unchanged. test_dax.py
+# rides along to prove the seed-era serverless surface still holds.
+for seed in 1 7; do
+    PILOSA_TPU_CRASH_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_dax.py tests/test_dax_elastic.py \
+        -q -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+done
+
+echo "== elastic serverless bench gate (bench.py --configs 19) =="
+# Hard-asserts the ISSUE 16 acceptance bar in-process: a DaxCluster
+# under mixed load with a kill, a silence, and scale-ups mid-flight
+# loses zero acked writes (fresh-computer replay checksum bit-identical
+# to the single-node oracle), rebuilds a restarted computer via a FULL
+# resync, and serves from a freshly-directed node at p99 <= 2x the warm
+# fleet within 5s of its directive (warm handoff: replay + prewarm
+# before ack).
+JAX_PLATFORMS=cpu python bench.py --configs 19 || exit $?
+
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver
 # wrappers when present. CI gates fatally against a pinned baseline.

@@ -1,0 +1,589 @@
+"""Elastic serverless plane tests (dax/): directive protocol edges,
+group-commit durability, the crash matrix over the ``dax.*`` kill
+sites, SWIM-driven liveness, warm handoff, autoscaling, and the
+zero-cost-when-off contract.
+
+``PILOSA_TPU_CRASH_SEED`` (scripts/tier1.sh dax lane) steers the
+seed-derived kill plan; default runs use a fixed fallback so the crash
+matrix always runs a real plan.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.cluster.client import NodeDownError
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.dax.autoscale import Autoscaler
+from pilosa_tpu.dax.computer import Computer
+from pilosa_tpu.dax.controller import Controller
+from pilosa_tpu.dax.directive import (
+    Directive, METHOD_DIFF, METHOD_FULL, METHOD_RESET,
+)
+from pilosa_tpu.dax.harness import DaxCluster
+from pilosa_tpu.dax.storage import Snapshotter, WriteLogger
+from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.obs.metrics import MetricsRegistry
+from pilosa_tpu.sched.clock import ManualClock
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.storage.recovery import (
+    CrashPlan, DAX_CRASH_SITES, SimulatedCrash,
+)
+
+SCHEMA = [{"index": "t", "options": {}, "fields": [
+    {"name": "f", "options": {"type": "set"}},
+    {"name": "n", "options": {"type": "int"}}]}]
+
+
+def _full(version, shards, hot=()):
+    return Directive(
+        version=version, method=METHOD_FULL,
+        schema=[dict(t) for t in SCHEMA],
+        assigned=[("t", s) for s in shards],
+        hot=list(hot)).to_json()
+
+
+def _ops(k=90, seed=3):
+    """Deterministic idempotent workload: set bits + int values over two
+    shards (idempotence is what makes crash-retry well-defined)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(k):
+        shard = int(rng.integers(0, 2))
+        col = shard * SHARD_WIDTH + int(rng.integers(0, 500))
+        if i % 4 == 3:
+            out.append(("vals", [col], [int(rng.integers(-40, 40))]))
+        else:
+            out.append(("bits", [int(rng.integers(0, 6))], [col]))
+    return out
+
+
+def _apply_ops(target, ops, start=0):
+    """Apply ops[start:] through import_bits/import_values; returns the
+    index of the first op that crashed (None = all applied)."""
+    for i in range(start, len(ops)):
+        kind, a, b = ops[i]
+        try:
+            if kind == "bits":
+                target.import_bits("t", "f", rows=a, cols=b)
+            else:
+                target.import_values("t", "n", cols=a, values=b)
+        except SimulatedCrash:
+            return i
+    return None
+
+
+def _oracle(ops):
+    api = API()
+    api.create_index("t", {})
+    api.create_field("t", "f", {"type": "set"})
+    api.create_field("t", "n", {"type": "int"})
+    _apply_ops(api, ops)
+    return api.checksum()
+
+
+class TestDirectiveProtocol:
+    def test_reset_wipes_local_state(self, tmp_path):
+        comp = Computer("c0", str(tmp_path))
+        comp.apply_directive(_full(1, [0]))
+        comp.import_bits("t", "f", rows=[1], cols=[2])
+        assert comp.api.holder.indexes
+        out = comp.apply_directive(
+            Directive(version=2, method=METHOD_RESET,
+                      schema=[], assigned=[]).to_json())
+        assert out["applied"]
+        assert not comp.api.holder.indexes
+        assert comp.assigned == set()
+
+    def test_diff_applies_delta_without_schema(self, tmp_path):
+        comp = Computer("c0", str(tmp_path))
+        comp.apply_directive(_full(1, [0]))
+        out = comp.apply_directive(Directive(
+            version=2, method=METHOD_DIFF, base_version=1,
+            add=[("t", 1)], remove=[("t", 0)],
+            assigned=[("t", 1)], schema_changed=False).to_json())
+        assert out["applied"]
+        assert comp.assigned == {("t", 1)}
+        assert "t" in comp.api.holder.indexes  # schema untouched
+
+    def test_diff_after_missed_version_asks_resync(self, tmp_path):
+        comp = Computer("c0", str(tmp_path))
+        comp.apply_directive(_full(1, [0]))
+        out = comp.apply_directive(Directive(
+            version=3, method=METHOD_DIFF, base_version=2,
+            add=[("t", 1)], assigned=[("t", 0), ("t", 1)],
+            schema_changed=False).to_json())
+        assert out == {"version": 1, "applied": False, "resync": True}
+        # the FULL fallback then lands
+        out = comp.apply_directive(_full(3, [0, 1]))
+        assert out["applied"]
+        assert comp.assigned == {("t", 0), ("t", 1)}
+
+    def test_stale_version_rejected(self, tmp_path):
+        comp = Computer("c0", str(tmp_path))
+        comp.apply_directive(_full(5, [0]))
+        out = comp.apply_directive(_full(4, [0, 1]))
+        assert not out["applied"]
+        assert comp.assigned == {("t", 0)}
+
+
+class _FakeComp:
+    """Directive sink with scriptable failure for controller tests."""
+
+    def __init__(self):
+        self.directives = []
+        self.fail = False
+        self.resync_once = False
+
+    def apply_directive(self, d):
+        if self.fail:
+            raise NodeDownError("down")
+        if self.resync_once and d["method"] == METHOD_DIFF:
+            self.resync_once = False
+            return {"version": d["version"], "applied": False,
+                    "resync": True}
+        self.directives.append(d)
+        return {"version": d["version"], "applied": True}
+
+
+class TestControllerDelivery:
+    def _controller(self, tmp_path, registry=None):
+        return Controller(str(tmp_path), sleep=lambda s: None,
+                          directive_backoff_s=0.0,
+                          registry=registry or MetricsRegistry())
+
+    def test_second_push_is_diff(self, tmp_path):
+        ctl = self._controller(tmp_path)
+        a = _FakeComp()
+        ctl.register(Node(id="a", uri=""), computer=a)
+        ctl.create_table("t", {}, SCHEMA[0]["fields"])
+        ctl.ensure_shard("t", 0)
+        methods = [d["method"] for d in a.directives]
+        assert methods[0] == METHOD_FULL
+        assert METHOD_DIFF in methods[1:]
+        last = a.directives[-1]
+        assert last["method"] == METHOD_DIFF
+        assert last["add"] == [["t", 0]]
+        # schema didn't change between the table push and the shard
+        # assignment — the diff must not recarry it
+        assert last["schemaChanged"] is False
+        assert last["schema"] == []
+
+    def test_resync_falls_back_to_full(self, tmp_path):
+        reg = MetricsRegistry()
+        ctl = self._controller(tmp_path, registry=reg)
+        a = _FakeComp()
+        ctl.register(Node(id="a", uri=""), computer=a)
+        ctl.create_table("t", {}, SCHEMA[0]["fields"])
+        a.resync_once = True
+        ctl.ensure_shard("t", 0)
+        assert a.directives[-1]["method"] == METHOD_FULL
+        assert a.directives[-1]["assigned"] == [["t", 0]]
+        assert reg.value(obs_metrics.METRIC_DAX_FULL_RESYNCS) == 1
+
+    def test_mid_batch_failure_converges_no_double_delivery(self, tmp_path):
+        ctl = self._controller(tmp_path)
+        a, b = _FakeComp(), _FakeComp()
+        ctl.register(Node(id="a", uri=""), computer=a)
+        ctl.register(Node(id="b", uri=""), computer=b)
+        ctl.create_table("t", {}, SCHEMA[0]["fields"])
+        for s in range(8):
+            ctl.ensure_shard("t", s)
+        assert {nid for nid in ctl.assignment().values()} == {"a", "b"}
+        # b dies; the next broadcast push fails mid-batch and must
+        # converge: b buried, its shards on a, a redirected exactly once
+        b.fail = True
+        ctl.create_field("t", "extra", {"type": "set"})
+        assert "b" in ctl.dead
+        assert set(ctl.assignment().values()) == {"a"}
+        final = Directive.from_json(a.directives[-1]) \
+            if a.directives[-1]["method"] == METHOD_FULL else None
+        owned = {tuple(x) for x in a.directives[-1]["assigned"]}
+        assert owned == {("t", s) for s in range(8)}
+        versions = [d["version"] for d in a.directives]
+        assert len(versions) == len(set(versions)), \
+            "a directive version was delivered twice to the same node"
+
+    def test_rebalance_moves_shards_to_new_node(self, tmp_path):
+        ctl = self._controller(tmp_path)
+        a = _FakeComp()
+        ctl.register(Node(id="a", uri=""), computer=a)
+        ctl.create_table("t", {}, SCHEMA[0]["fields"])
+        for s in range(12):
+            ctl.ensure_shard("t", s)
+        b = _FakeComp()
+        ctl.register(Node(id="b", uri=""), computer=b)
+        moved = ctl.rebalance()
+        assert moved > 0
+        owners = set(ctl.assignment().values())
+        assert owners == {"a", "b"}
+        # the loser learned about its removals too
+        removed = {tuple(x) for d in a.directives
+                   if d["method"] == METHOD_DIFF
+                   for x in d.get("remove", [])}
+        b_owned = {k for k, v in ctl.assignment().items() if v == "b"}
+        assert b_owned <= removed | set()
+
+
+class TestDropTableResurrection:
+    def test_recreate_after_drop_is_empty(self, tmp_path):
+        c = DaxCluster(2, shared_dir=str(tmp_path))
+        try:
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            c.queryer.import_bits("t", "f", rows=[1, 1, 1],
+                                  cols=[5, 10, SHARD_WIDTH + 3])
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == 3
+            c.controller.drop_table("t")
+            assert c.controller.wl.tables() == []
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == 0
+            # cold start over the same dir must not resurrect either
+            assert c.controller.wl.shards("t") == []
+        finally:
+            c.close()
+
+
+class TestGroupCommit:
+    def test_one_fsync_per_shard_not_per_op(self, tmp_path, monkeypatch):
+        calls = {"n": 0}
+        real = os.fsync
+
+        def counting(fd):
+            calls["n"] += 1
+            return real(fd)
+
+        # 60 write calls in one request: each appends its own log op,
+        # but batch mode pays ONE commit fsync per touched shard
+        pql = "".join(f"Set({i}, f=1)" for i in range(60))
+        comp = Computer("c0", str(tmp_path / "batch"), snapshot_every=10_000)
+        comp.apply_directive(_full(1, [0]))
+        monkeypatch.setattr(os, "fsync", counting)
+        comp.query_remote("t", pql, shards=[0])
+        batch_fsyncs = calls["n"]
+        assert batch_fsyncs <= 2, \
+            f"group commit issued {batch_fsyncs} fsyncs for one request"
+        # the always mode pays per-op — the gap IS the feature
+        monkeypatch.setattr(os, "fsync", real)
+        comp2 = Computer("c1", str(tmp_path / "always"), sync="always",
+                         snapshot_every=10_000)
+        comp2.apply_directive(_full(1, [0]))
+        monkeypatch.setattr(os, "fsync", counting)
+        calls["n"] = 0
+        comp2.query_remote("t", pql, shards=[0])
+        assert calls["n"] >= 60
+        assert batch_fsyncs * 10 < calls["n"]
+        # both modes end at the same durable state
+        assert len(list(comp.wl.replay("t", 0, 0))) == \
+            len(list(comp2.wl.replay("t", 0, 0))) == 60
+
+    def test_torn_tail_stops_replay(self, tmp_path):
+        wl = WriteLogger(str(tmp_path))
+        for i in range(10):
+            wl.append("t", 0, {"k": "bits", "f": "f", "r": [i], "c": [i]})
+        wl.commit("t", 0)
+        wl.close()
+        d = tmp_path / "wl" / "t"
+        seg = sorted(p for p in os.listdir(d) if p.startswith("0."))[-1]
+        path = d / seg
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 3)
+        wl2 = WriteLogger(str(tmp_path))
+        ops = list(wl2.replay("t", 0, 0))
+        assert len(ops) == 9  # the torn final frame was never acked
+        assert [op["r"][0] for op in ops] == list(range(9))
+
+    def test_adopts_seed_era_jsonl(self, tmp_path):
+        import json
+
+        d = tmp_path / "wl" / "t"
+        os.makedirs(d)
+        with open(d / "0.jsonl", "w") as f:
+            for i in range(3):
+                f.write(json.dumps({"k": "bits", "f": "f",
+                                    "r": [i], "c": [i]}) + "\n")
+        wl = WriteLogger(str(tmp_path))
+        assert wl.shards("t") == [0]
+        ops = list(wl.replay("t", 0, 0))
+        assert len(ops) == 3
+        assert wl.length("t", 0) == 3
+        assert not os.path.exists(d / "0.jsonl")  # rewritten, removed
+        # appends continue past the adopted prefix
+        wl.append("t", 0, {"k": "bits", "f": "f", "r": [9], "c": [9]})
+        wl.commit("t", 0)
+        assert wl.length("t", 0) == 4
+
+
+class TestSnapshotter:
+    def test_prune_skips_newer_versions(self, tmp_path):
+        s = Snapshotter(str(tmp_path))
+        s.write("t", 0, 5, {"a": np.array([1, 2, 3])})
+        # a slow OLD owner lands its stale snapshot after the new
+        # owner's — it must not delete the newer work
+        s.write("t", 0, 3, {"a": np.array([9])})
+        assert s.latest_version("t", 0) == 5
+        v, arrays = s.latest("t", 0)
+        assert v == 5 and list(arrays["a"]) == [1, 2, 3]
+        s.write("t", 0, 6, {"a": np.array([4])})
+        assert s._versions("t", 0) == [6]  # 3 and 5 pruned
+
+
+class TestCrashMatrix:
+    """Every dax.* kill point: the next owner resumes bit-identical to
+    an uncrashed oracle once the unacked suffix is retried (set/int ops
+    are idempotent — the client-retry contract)."""
+
+    def _run(self, dirpath, plan, ops):
+        comp = Computer("c0", dirpath, snapshot_every=8, crash_plan=plan)
+        start = 0
+        try:
+            comp.apply_directive(_full(1, [0, 1]))
+        except SimulatedCrash:
+            start = 0
+        else:
+            start = _apply_ops(comp, ops)
+        # next owner: clean plan, same shared dir — replay + retry
+        comp2 = Computer("c1", dirpath, snapshot_every=8)
+        comp2.apply_directive(_full(2, [0, 1]))
+        if start is not None:
+            assert _apply_ops(comp2, ops, start) is None
+        return comp2.api.checksum()
+
+    @pytest.mark.parametrize("site", DAX_CRASH_SITES)
+    @pytest.mark.parametrize("at", [1, 2])
+    def test_kill_point_resumes_bit_identical(self, tmp_path, site, at):
+        ops = _ops()
+        golden = _oracle(ops)
+        plan = CrashPlan().kill(site, at=at)
+        got = self._run(str(tmp_path), plan, ops)
+        assert got == golden
+
+    def test_env_seeded_plan(self, tmp_path):
+        """The tier1 dax lane's seed (PILOSA_TPU_CRASH_SEED) draws a
+        deterministic plan over the dax site tuple — from_env() stays
+        the storage lane's, so this lane can't steal its kill points."""
+        seed = os.environ.get("PILOSA_TPU_CRASH_SEED", "lane-default")
+        plan = CrashPlan.dax_seeded(seed)
+        assert plan._arms == CrashPlan.dax_seeded(seed)._arms
+        assert all(s in DAX_CRASH_SITES for s in plan._arms)
+        ops = _ops()
+        golden = _oracle(ops)
+        assert self._run(str(tmp_path), plan, ops) == golden
+
+    def test_sites_disjoint_from_other_lanes(self):
+        from pilosa_tpu.storage.recovery import (
+            CRASH_SITES, STREAM_CRASH_SITES,
+        )
+
+        assert not set(DAX_CRASH_SITES) & set(CRASH_SITES)
+        assert not set(DAX_CRASH_SITES) & set(STREAM_CRASH_SITES)
+
+
+class TestMembershipLiveness:
+    def test_silence_detected_via_membership(self, tmp_path):
+        clock = ManualClock()
+        c = DaxCluster(3, shared_dir=str(tmp_path), membership=True,
+                       clock=clock)
+        try:
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            cols = [s * SHARD_WIDTH + i for s in range(4) for i in range(20)]
+            c.queryer.import_bits("t", "f", rows=[1] * len(cols), cols=cols)
+            victim = 1
+            vid = c.computers[victim].node.id
+            had = {k for k, v in c.controller.assignment().items()
+                   if v == vid}
+            c.silence(victim)
+            for _ in range(150):
+                c.step()
+                clock.advance(0.4)
+                if vid in c.controller.dead:
+                    break
+            assert vid in c.controller.dead, \
+                "membership never confirmed the silenced node down"
+            assert all(v != vid for v in c.controller.assignment().values())
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == len(cols)
+        finally:
+            c.close()
+
+
+class TestWarmHandoff:
+    def test_prewarm_builds_stacks_before_ack(self, tmp_path):
+        seeder = Computer("c0", str(tmp_path))
+        seeder.apply_directive(_full(1, [0, 1]))
+        _apply_ops(seeder, _ops())
+        reg = MetricsRegistry()
+        warm = Computer("c1", str(tmp_path), registry=reg)
+        out = warm.apply_directive(_full(2, [0, 1],
+                                         hot=[("t", "f"), ("t", "n")]))
+        # the ack and the prewarm are one step: by the time applied=True
+        # is visible the hot planes are resident
+        assert out["applied"]
+        assert reg.value(obs_metrics.METRIC_DAX_PREWARM_STACKS) > 0
+        assert reg.value(obs_metrics.METRIC_DAX_REPLAY_OPS) > 0
+
+    def test_handoff_off_skips_prewarm(self, tmp_path):
+        seeder = Computer("c0", str(tmp_path))
+        seeder.apply_directive(_full(1, [0, 1]))
+        _apply_ops(seeder, _ops())
+        reg = MetricsRegistry()
+        cold = Computer("c1", str(tmp_path), warm_handoff=False,
+                        registry=reg)
+        assert cold.apply_directive(
+            _full(2, [0, 1], hot=[("t", "f")]))["applied"]
+        assert reg.value(obs_metrics.METRIC_DAX_PREWARM_STACKS) == 0
+
+
+class TestAutoscaler:
+    def _scaler(self, probes, clock, **kw):
+        state = {"pool": 2}
+
+        def up():
+            state["pool"] += 1
+            return state["pool"]
+
+        def down():
+            state["pool"] -= 1
+            return state["pool"]
+
+        scaler = Autoscaler(
+            probes_fn=lambda: probes, scale_up=up, scale_down=down,
+            pool_size=lambda: state["pool"], min_nodes=1, max_nodes=4,
+            cooldown_s=10.0, queue_high=16, p99_high_ms=250.0,
+            settle_ticks=3, clock=clock, registry=MetricsRegistry(), **kw)
+        return scaler, state
+
+    def test_scales_up_on_pressure_with_cooldown(self):
+        clock = ManualClock()
+        probes = {"queue_depth": 99, "leg_p99_ms": 10.0}
+        scaler, state = self._scaler(probes, clock)
+        assert scaler.tick() == "up"
+        assert state["pool"] == 3
+        assert scaler.tick() is None  # cooldown holds
+        clock.advance(11.0)
+        assert scaler.tick() == "up"
+        assert state["pool"] == 4
+        clock.advance(11.0)
+        assert scaler.tick() is None  # max_nodes bound
+
+    def test_scales_down_only_after_settle(self):
+        clock = ManualClock()
+        probes = {"queue_depth": 0, "leg_p99_ms": 1.0}
+        scaler, state = self._scaler(probes, clock)
+        assert scaler.tick() is None
+        assert scaler.tick() is None
+        assert scaler.tick() == "down"  # third consecutive cold tick
+        assert state["pool"] == 1
+        clock.advance(11.0)
+        for _ in range(5):
+            scaler.tick()
+        assert state["pool"] == 1  # min_nodes floor
+
+    def test_p99_alone_triggers(self):
+        clock = ManualClock()
+        probes = {"queue_depth": 0, "leg_p99_ms": 900.0}
+        scaler, state = self._scaler(probes, clock)
+        assert scaler.tick() == "up"
+
+
+class TestServingPlane:
+    def test_cached_reads_and_write_invalidation(self, tmp_path):
+        c = DaxCluster(2, shared_dir=str(tmp_path), serving=True)
+        try:
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            c.queryer.query("t", "Set(5, f=1)")
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == 1
+            hits0 = c.queryer.cache.stats()["hits"]
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == 1
+            assert c.queryer.cache.stats()["hits"] == hits0 + 1
+            # a write through this front-end invalidates — no stale read
+            c.queryer.query("t", "Set(9, f=1)")
+            assert c.queryer.query("t", "Count(Row(f=1))")[0] == 2
+            # queried fields feed the prewarm set
+            assert ("t", "f") in [
+                (t, f) for t in c.controller._hot
+                for f in c.controller._hot[t]] or \
+                "f" in c.controller._hot.get("t", [])
+        finally:
+            c.close()
+
+    def test_probe_reports_serving_pressure(self, tmp_path):
+        c = DaxCluster(2, shared_dir=str(tmp_path), serving=True)
+        try:
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            c.queryer.query("t", "Set(5, f=1)")
+            c.queryer.query("t", "Count(Row(f=1))")
+            p = c.queryer.probe()
+            assert p["serving"] is True
+            assert p["leg_p99_ms"] > 0.0
+            cp = c.controller.probe()
+            assert cp["version"] >= 1
+            assert cp["directive_age_s"] >= 0.0
+        finally:
+            c.close()
+
+    def test_scale_up_mid_flight_keeps_results(self, tmp_path):
+        c = DaxCluster(2, shared_dir=str(tmp_path), serving=True,
+                       snapshot_every=8)
+        try:
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            cols = [s * SHARD_WIDTH + i for s in range(4) for i in range(25)]
+            c.queryer.import_bits("t", "f", rows=[2] * len(cols), cols=cols)
+            assert c.queryer.query("t", "Count(Row(f=2))")[0] == len(cols)
+            before = len(c.controller.live_ids())
+            c.scale_up()
+            assert len(c.controller.live_ids()) == before + 1
+            new_id = c.computers[-1].node.id
+            assert new_id in set(c.controller.assignment().values()), \
+                "rebalance moved nothing to the new node"
+            assert c.queryer.query("t", "Count(Row(f=2))")[0] == len(cols)
+        finally:
+            c.close()
+
+
+class TestZeroCostOff:
+    def test_dax_not_imported_by_classic_paths(self):
+        code = ("import pilosa_tpu.api, pilosa_tpu.cluster.node, sys; "
+                "print(any(m.startswith('pilosa_tpu.dax') "
+                "for m in sys.modules))")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "False"
+
+    def test_no_dax_metrics_without_plane(self):
+        reg = MetricsRegistry()
+        assert all(not name.startswith("dax_")
+                   for (name, _labels) in list(reg._counters)
+                   + list(reg._gauges))
+
+
+class TestObsWiring:
+    def test_directive_churn_flight_trigger(self, tmp_path):
+        from pilosa_tpu.obs.health import HealthPlane
+
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        hp = HealthPlane(registry=reg, clock=clock, interval_ms=100.0,
+                         directive_churn_bumps=4.0)
+        c = DaxCluster(2, shared_dir=str(tmp_path), http=False,
+                       clock=clock)
+        try:
+            hp.attach_dax(queryer=c.queryer, controller=c.controller)
+            probe = c.controller.probe()
+            assert probe["enabled"] and "recent_directive_bumps" in probe
+            hp.timeline.sample()
+            assert hp.flight.bundles() == []  # 2 register bumps: normal
+            clock.advance(1.0)
+            c.controller.create_table("t", {}, SCHEMA[0]["fields"])
+            c.controller.create_field("t", "g", {"type": "set"})
+            c.controller.create_field("t", "h", {"type": "set"})
+            hp.timeline.sample()
+            bundles = hp.flight.bundles()
+            assert [b["trigger"] for b in bundles] == ["directive_churn"]
+            assert "directive bumps" in bundles[0]["reason"]
+        finally:
+            c.close()
