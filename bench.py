@@ -2062,6 +2062,159 @@ def bench_config19(device: str) -> None:
     shutil.rmtree(cluster.dir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Config 20 — Pallas L0 kernel-plane gate (ops/pallas_util.py)
+# ---------------------------------------------------------------------------
+
+def bench_config20(device: str) -> None:
+    """Pallas kernel-plane gate: three phases over one fixed workload.
+
+    1. kill switch (``PILOSA_TPU_PALLAS=0``) — run every routed family;
+       HARD asserts: zero Pallas dispatches AND zero fallback-counter
+       movement (the switch must cost nothing, not even a metric tick).
+       These results are the classic oracle.
+    2. forced (``PILOSA_TPU_PALLAS=1``; interpret mode off-TPU) — same
+       inputs through the Pallas kernels; HARD asserts: bit-identical
+       results for EVERY family and a dispatch-counter tick per family.
+    3. speedup — p50 classic vs Pallas for the bsi_sum and pair-count
+       matmul kernels. On TPU backends HARD assert >= 1.3x; on CPU the
+       interpreter is a correctness vehicle, so the ratio is emitted
+       ungated.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.core.fragment import SetFragment
+    from pilosa_tpu.obs import metrics as obs_metrics
+    from pilosa_tpu.ops import bsi as S
+    from pilosa_tpu.ops import groupby as G
+    from pilosa_tpu.ops import pallas_util as PU
+    from pilosa_tpu.ops import topk as T
+    from pilosa_tpu.parallel import mesh
+
+    rng = np.random.default_rng(20)
+    words = 512
+    nbits = words * 32
+    a = rng.integers(0, 1 << 32, size=(8, words), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=(16, words), dtype=np.uint32)
+    cols = np.unique(rng.integers(0, nbits, size=2000))
+    vals = rng.integers(-5000, 5000, size=cols.size)
+    depth = max(S.bits_needed(int(vals.min())),
+                S.bits_needed(int(vals.max())))
+    planes = S.encode_values(cols, vals, depth, words)
+    frag_rows = rng.integers(0, 8, size=500)
+    frag_cols = rng.integers(0, nbits, size=500)
+    tape = (("and", 0, 1),)
+    leaves = [jnp.asarray(a[0]), jnp.asarray(a[1])]
+
+    reg = obs_metrics.REGISTRY
+
+    def pallas_counter_totals():
+        snap = reg.snapshot()["counters"]
+        disp = sum(v for k, v in snap.items()
+                   if k.startswith(obs_metrics.METRIC_OPS_PALLAS_DISPATCH))
+        fall = sum(v for k, v in snap.items()
+                   if k.startswith(obs_metrics.METRIC_OPS_PALLAS_FALLBACK))
+        return disp, fall
+
+    def families(label):
+        """One result per routed family, all host-side values."""
+        out = {}
+        out["pair_counts"] = np.asarray(G.pair_counts(a, b))
+        out["bsi_sum"] = S.bsi_sum(planes, planes[S.EXISTS])
+        out["bsi_compare"] = np.asarray(
+            S.bsi_compare(planes, S.BETWEEN, -100, 100))
+        tc, ti = T.top_rows(a, 5)
+        out["topn"] = (np.asarray(tc), np.asarray(ti))
+        frag = SetFragment(0, words=words)
+        out["ingest_scatter"] = (
+            frag.set_many(frag_rows, frag_cols),
+            {r: frag.row_plane(r).copy() for r in frag.existing_rows()})
+        fn = mesh.compile_tape_count(tape, False, words)
+        out["tape_count"] = (int(fn(*leaves)),
+                             bool(getattr(fn, "pallas_terminal", False)))
+        return out
+
+    saved = os.environ.get("PILOSA_TPU_PALLAS")
+    PU.reset_failures()
+    try:
+        # -- phase 1: kill switch — classic oracle, zero-overhead gate -----
+        os.environ["PILOSA_TPU_PALLAS"] = "0"
+        d0, f0 = pallas_counter_totals()
+        oracle = families("killswitch")
+        d1, f1 = pallas_counter_totals()
+        assert d1 == d0, "kill switch still dispatched a pallas kernel"
+        assert f1 == f0, "kill switch ticked the fallback counter"
+        assert oracle["tape_count"][1] is False, \
+            "kill switch compiled a pallas tape terminal"
+
+        # -- phase 2: forced — bit-identity + dispatch accounting ----------
+        os.environ["PILOSA_TPU_PALLAS"] = "1"
+        got = families("forced")
+        d2, _ = pallas_counter_totals()
+        assert d2 >= d1 + 5, \
+            f"forced phase dispatched {d2 - d1} pallas kernels, want >=5"
+        assert got["tape_count"][1] is True, \
+            "forced phase did not compile the pallas tape terminal"
+        np.testing.assert_array_equal(got["pair_counts"],
+                                      oracle["pair_counts"])
+        assert got["bsi_sum"] == oracle["bsi_sum"]
+        np.testing.assert_array_equal(got["bsi_compare"],
+                                      oracle["bsi_compare"])
+        np.testing.assert_array_equal(got["topn"][0], oracle["topn"][0])
+        assert got["ingest_scatter"][0] == oracle["ingest_scatter"][0]
+        for r, plane in oracle["ingest_scatter"][1].items():
+            np.testing.assert_array_equal(
+                got["ingest_scatter"][1][r], plane)
+        assert got["tape_count"][0] == oracle["tape_count"][0]
+        verified = 6
+
+        # -- phase 3: speedup (hard-gated on TPU only) ---------------------
+        wide = rng.integers(0, 1 << 32, size=(64, _n(32768)),
+                            dtype=np.uint32)
+        filt = wide[0]
+
+        def classic():
+            G._pair_counts_xla(wide[:8], wide)
+            S._plane_popcounts_xla(
+                jnp.asarray(planes), jnp.asarray(planes[S.EXISTS]))
+
+        def pallas():
+            G.pair_counts(wide[:8], wide)
+            S.bsi_plane_popcounts(planes, planes[S.EXISTS])
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if on_tpu:
+            classic_ms = _p50_ms(classic)
+            pallas_ms = _p50_ms(pallas)
+            speedup = classic_ms / max(pallas_ms, 1e-9)
+            assert speedup >= 1.3, \
+                f"pallas bsi_sum/pair_counts speedup {speedup:.2f}x < 1.3x"
+        else:
+            # interpret mode is a correctness vehicle, not a fast path:
+            # time one round trip each so the ratio is visible, ungated
+            t0 = time.perf_counter()
+            classic()
+            classic_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            pallas()
+            pallas_ms = (time.perf_counter() - t0) * 1e3
+            speedup = classic_ms / max(pallas_ms, 1e-9)
+        del filt
+    finally:
+        if saved is None:
+            os.environ.pop("PILOSA_TPU_PALLAS", None)
+        else:
+            os.environ["PILOSA_TPU_PALLAS"] = saved
+        PU.reset_failures()
+
+    _emit(f"c20_pallas_parity{SCALED} ({device})",
+          float(verified), "families", 1.0,
+          dispatches=int(d2 - d1), killswitch_dispatches=int(d1 - d0),
+          classic_ms=classic_ms, pallas_ms=pallas_ms,
+          speedup=speedup, speedup_gated=on_tpu)
+
+
 _CONFIGS = {
     "1": bench_config1,
     "2": bench_config2,
@@ -2081,6 +2234,7 @@ _CONFIGS = {
     "17": bench_config17,
     "18": bench_config18,
     "19": bench_config19,
+    "20": bench_config20,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

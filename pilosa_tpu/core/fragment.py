@@ -29,6 +29,8 @@ import numpy as np
 
 from pilosa_tpu import native, platform
 from pilosa_tpu.ops import bsi as bsiops
+from pilosa_tpu.ops import pallas_util as _pallas
+from pilosa_tpu.ops import scatter as scatterops
 from pilosa_tpu.ops.bitmap import bits_to_plane
 from pilosa_tpu.shardwidth import BITS_PER_WORD, WORDS_PER_SHARD
 
@@ -235,15 +237,40 @@ class SetFragment:
             self.planes = _grow_rows(self.planes, len(self.row_ids) + n_new)
         record_deltas = cols.size <= _DELTA_MAX_COLS
         payloads = []
-        for row, (sel,) in groups:
-            s = self._slot(row)
-            sel = np.unique(sel)
-            # fused gather+scatter: count bits not already set while
-            # setting them — O(|sel|), no full-plane popcount (native
-            # C++ kernel, numpy fallback)
-            changed += native.scatter_new_bits(self.planes[s], sel)
-            if record_deltas:
-                payloads.append((row, tuple(int(c) for c in sel), ()))
+        # Device scatter path (ops/scatter.py): sort the whole import
+        # into unique word addresses host-side, merge + count changed
+        # bits in one fused Pallas pass — no per-row Python loop. The
+        # native loop below stays the classic path and oracle.
+        dev_done = False
+        why = scatterops.why_not_ingest(int(cols.size), len(groups),
+                                        self.words)
+        if why is None:
+            slots = np.array([self._slot(row) for row, _ in groups],
+                             dtype=np.int64)
+            sizes = [sel.size for _, (sel,) in groups]
+            try:
+                changed += scatterops.scatter_new_bits_bulk(
+                    self.planes, np.repeat(slots, sizes),
+                    np.concatenate([sel for _, (sel,) in groups]))
+                dev_done = True
+                if record_deltas:
+                    payloads = [
+                        (row, tuple(int(c) for c in np.unique(sel)), ())
+                        for row, (sel,) in groups]
+            except Exception as e:
+                _pallas.failed("ingest_scatter", e)
+        else:
+            _pallas.fallback("ingest_scatter", why)
+        if not dev_done:
+            for row, (sel,) in groups:
+                s = self._slot(row)
+                sel = np.unique(sel)
+                # fused gather+scatter: count bits not already set while
+                # setting them — O(|sel|), no full-plane popcount
+                # (native C++ kernel, numpy fallback)
+                changed += native.scatter_new_bits(self.planes[s], sel)
+                if record_deltas:
+                    payloads.append((row, tuple(int(c) for c in sel), ()))
         self.version += 1
         if not record_deltas:
             self.deltas.reset(self.version)

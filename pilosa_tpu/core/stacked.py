@@ -425,7 +425,9 @@ class StackedSet:
     def row_counts(self, filt: Optional[jax.Array] = None) -> jax.Array:
         """Device ``[cap]`` per-slot popcounts (optionally filtered),
         streamed per block (reference: fragment.go:1317 top counts)."""
-        parts = [sync_part(bitops.row_counts(blk, filt))
+        from pilosa_tpu.ops import topk as topkops
+
+        parts = [sync_part(topkops.row_counts(blk, filt))
                  for _, blk in self.iter_blocks()]
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 

@@ -138,6 +138,33 @@ def tape_cost(kind: str, tape: Tuple, n_leaves: int, masked: bool,
     over ``total_words`` uint32 words (conventions in the module doc)."""
     global _COST_EVALS
     _COST_EVALS += 1
+    if kind == "pallas":
+        # Pallas kernel-plane families (ops/pallas_util.kernel_scope):
+        # one 3-tuple tape entry (op, d1, d2). Conventions:
+        #   mm      bit-expand int8 MXU matmul C[d1, d2] contracting
+        #           32*total_words 0/1 lanes: 2*d1*d2*32*W FLOPs; HBM =
+        #           packed operand streams + the int32 result.
+        #   cmp     fused VPU compare walk, d1=depth, d2=constant sides:
+        #           ~6 word-ops per (plane, sign class, side) + 8 for
+        #           the sign partition/select; reads 2+depth planes,
+        #           writes one result plane.
+        #   scatter ingest merge+count pass (or + popcount-andnot):
+        #           reads planes+updates, writes merged.
+        op, d1, d2 = tape[0]
+        if op == "mm":
+            flops = 2.0 * d1 * d2 * BIT_LANES * total_words
+            hbm = float(WORD_BYTES) * (d1 + d2) * total_words \
+                + 4.0 * d1 * d2
+        elif op == "cmp":
+            word_ops = 6 * d1 * d2 + 8
+            flops = float(BIT_LANES) * word_ops * total_words
+            hbm = float(WORD_BYTES) * (3 + d1) * total_words
+        elif op == "scatter":
+            flops = float(BIT_LANES) * 2.0 * total_words
+            hbm = float(WORD_BYTES) * 3.0 * total_words
+        else:
+            raise ValueError(f"unknown pallas cost family {op!r}")
+        return flops, hbm
     word_ops = len(tape) + (1 if masked else 0)
     if kind == "count":
         word_ops += 1  # the popcount reduction pass

@@ -195,6 +195,14 @@ METRIC_KERNEL_GBPS = "device_kernel_achieved_gbps"
 METRIC_KERNEL_DISPATCH_US = "device_kernel_dispatch_us"  # histogram
 METRIC_KERNEL_H2D_BYTES = "device_kernel_h2d_bytes_total"
 METRIC_KERNEL_H2D_SECONDS = "device_kernel_h2d_seconds_total"
+# Pallas L0 kernel plane (ops/pallas_util.py): successful MXU/VPU
+# kernel dispatches per kernel family, and counted fallbacks to the
+# classic XLA path labelled with why (failures|tracer|shape|interpret|
+# backend|error|mesh) — silent per-call degradation shows up on the
+# timeline instead
+# of a debug log. The PILOSA_TPU_PALLAS=0 kill switch ticks neither.
+METRIC_OPS_PALLAS_DISPATCH = "ops_pallas_dispatch_total"
+METRIC_OPS_PALLAS_FALLBACK = "ops_pallas_fallback_total"
 # a warm compiled-tape dispatch is tens of µs of launch overhead on CPU
 # up through multi-ms sharded collectives; cold paths land in the tail
 KERNEL_DISPATCH_BUCKETS_US = (50.0, 100.0, 250.0, 500.0, 1000.0,

@@ -202,6 +202,46 @@ def plane_count(a):
     return jnp.sum(_popcount_i32(a))
 
 
+#: word-block per grid step of the Pallas popcount reduce (VPU tile)
+_PALLAS_POP_BW = 512
+
+
+def _popcount_sum_kernel(x_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    s = jnp.sum(lax.population_count(x_ref[...]).astype(jnp.int32))
+
+    @pl.when(g == 0)
+    def _():
+        out_ref[0, 0] = s
+
+    @pl.when(g != 0)
+    def _():
+        out_ref[0, 0] += s
+
+
+def plane_count_pallas_traced(plane, interpret: bool):
+    """Traceable Pallas popcount-sum of a flat plane (length a multiple
+    of 512 words): the count-tape terminal used by
+    ``parallel/mesh.compile_tape_count``. A 1-D grid streams (1, 512)
+    VMEM tiles through the VPU popcount and accumulates into one SMEM
+    scalar — the tape's bitwise ops fuse into the same pass upstream."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = plane.reshape(-1, _PALLAS_POP_BW)
+    out = pl.pallas_call(
+        _popcount_sum_kernel,
+        grid=(x.shape[0],),
+        in_specs=[pl.BlockSpec((1, _PALLAS_POP_BW), lambda g: (g, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        interpret=interpret,
+    )(x)
+    return out[0, 0]
+
+
 @platform.guarded_call
 @jax.jit
 def plane_intersection_count(a, b):

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu import platform
+from pilosa_tpu.ops import groupby as _gb
+from pilosa_tpu.ops import pallas_util as PU
 from pilosa_tpu.ops.bitmap import _popcount_i32 as _pc
 from pilosa_tpu.ops.bitmap import bits_to_plane
 
@@ -133,12 +135,100 @@ def _compare_kernel(planes, op, cbits, cover, cneg, c2bits, c2over, c2neg):
     raise ValueError(f"unknown op {op!r}")
 
 
+def _compare_pallas_body(op, depth, planes_ref, c_ref, out_ref):
+    """Fused VPU compare: one VMEM-tiled pass over all planes of a word
+    block. Same circuit as ``_compare_kernel``/``_mag_compare`` (the
+    bit-identity oracle), but the whole MSB->LSB walk — both sign
+    classes, both BETWEEN sides — runs on (1, BW) VMEM tiles with the
+    predicate constants as SMEM scalars: ``c_ref[side] = [bits LSB-
+    first..., overflow, neg]``."""
+    exists = planes_ref[0:1, :]
+    sign = planes_ref[1:2, :]
+    zeros = jnp.zeros_like(exists)
+    neg_rows = exists & sign
+    pos_rows = exists & ~sign
+
+    def mag_compare(cand, side):
+        eq, lt, gt = cand, zeros, zeros
+        for k in range(depth - 1, -1, -1):
+            pk = planes_ref[OFFSET + k:OFFSET + k + 1, :]
+            bit = c_ref[side, k] != 0
+            lt = lt | jnp.where(bit, eq & ~pk, zeros)
+            gt = gt | jnp.where(bit, zeros, eq & pk)
+            eq = eq & jnp.where(bit, pk, ~pk)
+        over = c_ref[side, depth] != 0
+        lt = jnp.where(over, cand, lt)
+        eq = jnp.where(over, zeros, eq)
+        gt = jnp.where(over, zeros, gt)
+        return lt, eq, gt
+
+    def signed_partition(side):
+        plt, peq, pgt = mag_compare(pos_rows, side)
+        nlt, neq, ngt = mag_compare(neg_rows, side)
+        cneg = c_ref[side, depth + 1] != 0
+        lt = jnp.where(cneg, ngt, neg_rows | plt)
+        eq = jnp.where(cneg, neq, peq)
+        gt = jnp.where(cneg, pos_rows | nlt, pgt)
+        return lt, eq, gt
+
+    lt, eq, gt = signed_partition(0)
+    if op == EQ:
+        out = eq
+    elif op == NE:
+        out = exists & ~eq
+    elif op == LT:
+        out = lt
+    elif op == LE:
+        out = lt | eq
+    elif op == GT:
+        out = gt
+    elif op == GE:
+        out = gt | eq
+    elif op == BETWEEN:
+        lt2, eq2, _ = signed_partition(1)
+        out = (gt | eq) & (lt2 | eq2)
+    else:
+        raise ValueError(f"unknown op {op!r}")
+    out_ref[...] = out
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def _compare_pallas(planes, cvec, op, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    depth = planes.shape[0] - OFFSET
+    nrows, w = planes.shape
+    bw = _gb._PALLAS_BW
+    pad_w = (-w) % bw
+    if pad_w:  # zero words carry no exists bits -> compare to zero there
+        planes = jnp.pad(planes, ((0, 0), (0, pad_w)))
+    rp = -(-nrows // 8) * 8  # sublane-pad the plane axis
+    if rp != nrows:
+        planes = jnp.pad(planes, ((0, rp - nrows), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_compare_pallas_body, op, depth),
+        grid=(planes.shape[1] // bw,),
+        in_specs=[
+            pl.BlockSpec((rp, bw), lambda g: (0, g)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bw), lambda g: (0, g)),
+        out_shape=jax.ShapeDtypeStruct((1, planes.shape[1]), planes.dtype),
+        interpret=interpret,
+    )(planes, cvec)
+    return out[0, :w]
+
+
 def bsi_compare(planes, op: str, value: int, value2: int | None = None):
     """Filter columns of a BSI plane stack by a signed predicate.
 
     ``value``/``value2`` are *stored-space* values (caller subtracts the
     field base first, as the reference does in field.go value ranges).
-    Returns a plane of matching columns.
+    Returns a plane of matching columns. Dispatch: eligible concrete
+    stacks take the fused Pallas VPU walk; the per-plane XLA circuit is
+    the classic path and bit-identity oracle.
     """
     depth = planes.shape[0] - OFFSET
     cbits, cover, cneg = value_bits(int(value), depth)
@@ -146,6 +236,25 @@ def bsi_compare(planes, op: str, value: int, value2: int | None = None):
         c2bits, c2over, c2neg = cbits, cover, cneg
     else:
         c2bits, c2over, c2neg = value_bits(int(value2), depth)
+    why = PU.why_not("bsi_compare", planes)
+    if why is None:
+        cvec = np.zeros((2, depth + 2), dtype=np.int32)
+        cvec[0, :depth], cvec[0, depth], cvec[0, depth + 1] = \
+            cbits, cover, cneg
+        cvec[1, :depth], cvec[1, depth], cvec[1, depth + 1] = \
+            c2bits, c2over, c2neg
+        try:
+            sides = 2 if op == BETWEEN else 1
+            with PU.kernel_scope("cmp", depth, sides, OFFSET + depth,
+                                 planes.shape[-1]):
+                out = _compare_pallas(planes, jnp.asarray(cvec), op,
+                                      PU.use_interpret())
+            PU.dispatched("bsi_compare")
+            return out
+        except Exception as e:
+            PU.failed("bsi_compare", e)
+    else:
+        PU.fallback("bsi_compare", why)
     return _compare_kernel(
         planes, op,
         jnp.asarray(cbits), jnp.asarray(cover), jnp.asarray(cneg),
@@ -215,14 +324,8 @@ def mask_filter(filt, mask_plane):
 
 @platform.guarded_call
 @jax.jit
-def bsi_plane_popcounts(planes, filt):
-    """Per-magnitude-plane popcounts split by sign, plus the filtered count.
-
-    Device returns int32s only; the host assembles the exact 64-bit sum
-    ``sum = Σ pos[k]<<k − Σ neg[k]<<k`` with Python ints (reference:
-    fragment.go:724 sum — same plane-popcount algorithm, scalar Go loop).
-    Returns (count, pos_counts[depth], neg_counts[depth]).
-    """
+def _plane_popcounts_xla(planes, filt):
+    """Classic per-plane popcount reduction (bit-identity oracle)."""
     exists = planes[EXISTS]
     sign = planes[SIGN]
     mags = planes[OFFSET:]
@@ -233,6 +336,54 @@ def bsi_plane_popcounts(planes, filt):
     pos_counts = jnp.sum(_pc(mags & pos[None, :]), axis=-1)
     neg_counts = jnp.sum(_pc(mags & neg[None, :]), axis=-1)
     return count, pos_counts, neg_counts
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _plane_popcounts_pallas(planes, filt, interpret):
+    """MXU formulation: popcount(P & Q) = Σc P[c]·Q[c], so every per-
+    plane popcount is one entry of the pair-count matmul — A = the two
+    sign classes, B = the magnitude planes plus an all-ones plane whose
+    column recovers the filtered count (pos and neg are disjoint, so
+    their popcounts add)."""
+    exists = planes[EXISTS]
+    sign = planes[SIGN]
+    mags = planes[OFFSET:]
+    rows = exists & filt
+    a = jnp.stack([rows & ~sign, rows & sign])
+    ones = jnp.full(filt.shape, 0xFFFFFFFF, dtype=planes.dtype)
+    b = jnp.concatenate([mags, ones[None, :]], axis=0)
+    c = _gb._pair_counts_traced(a, b, interpret)
+    return c[0, -1] + c[1, -1], c[0, :-1], c[1, :-1]
+
+
+def bsi_plane_popcounts(planes, filt):
+    """Per-magnitude-plane popcounts split by sign, plus the filtered count.
+
+    Device returns int32s only; the host assembles the exact 64-bit sum
+    ``sum = Σ pos[k]<<k − Σ neg[k]<<k`` with Python ints (reference:
+    fragment.go:724 sum — same plane-popcount algorithm, scalar Go loop).
+    Returns (count, pos_counts[depth], neg_counts[depth]). Dispatch:
+    eligible concrete stacks take the Pallas bit-expand + int8 MXU
+    matmul; the per-plane XLA reduction is the oracle fallback.
+    """
+    why = PU.why_not("bsi_sum", planes)
+    if why is None and isinstance(filt, jax.core.Tracer):
+        why = "tracer"
+    if why is None:
+        try:
+            depth = planes.shape[0] - OFFSET
+            with PU.kernel_scope("mm", 2, depth + 1, OFFSET + depth,
+                                 planes.shape[-1]):
+                out = _plane_popcounts_pallas(planes, filt,
+                                              PU.use_interpret())
+            PU.dispatched("bsi_sum")
+            return out
+        except Exception as e:
+            PU.failed("bsi_sum", e)
+    else:
+        PU.fallback("bsi_sum", why)
+    return _plane_popcounts_xla(planes, filt)
 
 
 def bsi_sum(planes, filt):

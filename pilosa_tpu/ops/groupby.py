@@ -22,13 +22,13 @@ materializing ``rows x 2^20`` lanes in HBM.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from pilosa_tpu import platform
+from pilosa_tpu.ops import pallas_util as PU
 from pilosa_tpu.ops.bitmap import zeros_varying_like
 
 # Words per column-block of the matmul: 2048 words = 65536 bit-columns
@@ -57,47 +57,32 @@ def pair_counts(a, b, block_words: int = BLOCK_WORDS):
     Used by GroupBy (rows of field1 x rows of field2) and by grouped
     aggregates (group bitmaps x BSI magnitude planes).
 
-    Dispatch: concrete arrays on a TPU backend take the fused Pallas
+    Dispatch: concrete arrays on a TPU backend (or anywhere under
+    ``PILOSA_TPU_PALLAS=1``, via the interpreter) take the fused Pallas
     expand+matmul kernel (~1.9x the XLA scan — the expansion stays in
     VMEM instead of staging int8 lanes through HBM); traced values
     (inside jit/shard_map, e.g. the mesh path's psum reduction) and
-    other backends take the XLA scan. PILOSA_TPU_NO_PALLAS=1 forces the
-    scan."""
-    if _pallas_eligible(a, b):
+    other backends take the XLA scan. Outcomes are counted on the
+    ``ops_pallas_*`` metrics (ops/pallas_util.py)."""
+    why = PU.why_not("pair_counts", a, b, max_rows=_PALLAS_MAX_R1)
+    if why is None:
         try:
-            return _pair_counts_pallas(a, b)
+            with PU.kernel_scope("mm", a.shape[0], b.shape[0], 2,
+                                 a.shape[1]):
+                out = _pair_counts_pallas(a, b)
+            PU.dispatched("pair_counts")
+            return out
         except Exception as e:
-            # Loud fallback; transient device errors get retries, but
-            # repeated failures (a real lowering bug) stop burning
-            # compile attempts on every query.
-            global _PALLAS_FAILURES
-            _PALLAS_FAILURES += 1
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "pallas pair_counts failed (%d/%d): %s — using XLA scan",
-                _PALLAS_FAILURES, _PALLAS_MAX_FAILURES, e)
+            PU.failed("pair_counts", e)
+    else:
+        PU.fallback("pair_counts", why)
     return _pair_counts_xla(a, b, block_words)
 
 
-_PALLAS_FAILURES = 0
-_PALLAS_MAX_FAILURES = 3
-
-
 def _pallas_eligible(a, b) -> bool:
-    if _PALLAS_FAILURES >= _PALLAS_MAX_FAILURES \
-            or os.environ.get("PILOSA_TPU_NO_PALLAS"):
-        return False
-    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
-        return False
-    if a.ndim != 2 or b.ndim != 2 or a.shape[0] > _PALLAS_MAX_R1:
-        return False
-    if a.shape[1] == 0:
-        return False  # zero-width grid would never run the kernel
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    """Shared eligibility rule (ops/pallas_util.py); bench.py pins its
+    kernel choice through this predicate."""
+    return PU.why_not("pair_counts", a, b, max_rows=_PALLAS_MAX_R1) is None
 
 
 def _expand_bitmajor(x):
@@ -128,13 +113,13 @@ def _pallas_kernel(a_ref, b_ref, out_ref):
         out_ref[:, :] += blk
 
 
-@platform.guarded_call
-@jax.jit
-def _pair_counts_pallas(a, b):
-    """Fused bit-expansion + int8 MXU matmul: the expansion lives in
-    VMEM per (512-word x 256-row) tile, so HBM sees only the packed
-    uint32 planes (measured 5.6 ms vs 10.7 ms XLA for the SSB config-3
-    contraction on v5e)."""
+def _pair_counts_traced(a, b, interpret: bool):
+    """Traceable core of the fused bit-expansion + int8 MXU matmul: the
+    expansion lives in VMEM per (512-word x 256-row) tile, so HBM sees
+    only the packed uint32 planes (measured 5.6 ms vs 10.7 ms XLA for
+    the SSB config-3 contraction on v5e). Shared by bsi_plane_popcounts
+    (magnitude-plane popcounts) and TopN row counts — any "popcount of
+    pairwise ANDs" is this one matmul."""
     from jax.experimental import pallas as pl
 
     r1, w_total = a.shape
@@ -158,8 +143,17 @@ def _pair_counts_pallas(a, b):
         ],
         out_specs=pl.BlockSpec((r1p, _PALLAS_TR2), lambda t, w: (0, t)),
         out_shape=jax.ShapeDtypeStruct((r1p, r2p), jnp.int32),
+        interpret=interpret,
     )(a, b)
     return out[:r1, :r2]
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pair_counts_pallas(a, b, interpret=None):
+    if interpret is None:  # static: resolved once per trace
+        interpret = PU.use_interpret()
+    return _pair_counts_traced(a, b, interpret)
 
 
 @platform.guarded_call

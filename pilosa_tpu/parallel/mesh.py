@@ -287,6 +287,9 @@ def compile_tape_count(tape, masked: bool, total_words: int):
     still inserts collectives from the leaf shardings when they happen
     to be placed). Callers cache the returned fn per (tape, shape
     bucket, mesh epoch)."""
+    from pilosa_tpu.ops import pallas_util as PU
+    from pilosa_tpu.ops.bitmap import _PALLAS_POP_BW, plane_count_pallas_traced
+
     mesh = engine_mesh()
     use_mesh = (mesh.devices.size > 1
                 and total_words % mesh.devices.size == 0)
@@ -302,7 +305,29 @@ def compile_tape_count(tape, masked: bool, total_words: int):
                 c = jnp.sum(_popcount_i32(_tape_result(tape, masked, largs)))
                 return lax.psum(c, (SHARD_AXIS, COL_AXIS))
             return f(*args)
+        PU.fallback("tape_count", "mesh")
     else:
+        # Pallas count terminal: the tape's bitwise ops trace as usual,
+        # the popcount reduce becomes the grid kernel. Decision happens
+        # once per compile; programs.py keys its cache on PU.mode_token
+        # so flipping the kill switch recompiles.
+        why = PU.why_not("tape_count")
+        if why is None and total_words % _PALLAS_POP_BW:
+            why = "shape"
+        if why is None:
+            interpret = PU.use_interpret()
+
+            @jax.jit
+            def fn(*args):
+                return plane_count_pallas_traced(
+                    _tape_result(tape, masked, args), interpret)
+
+            wrapped = platform.guarded_call(fn)
+            wrapped.pallas_terminal = True
+            return wrapped
+
+        PU.fallback("tape_count", why)
+
         @jax.jit
         def fn(*args):
             return jnp.sum(_popcount_i32(_tape_result(tape, masked, args)))

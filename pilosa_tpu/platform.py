@@ -137,6 +137,18 @@ def dispatch_guard():
     return _DISPATCH_LOCK if _GUARD_IS_LOCK else _NULL_GUARD
 
 
+def default_backend() -> str:
+    """Active JAX backend name (``cpu`` when init fails). One resolver
+    for the Pallas dispatch predicates so eligibility rules can't fork
+    per call site."""
+    import jax
+
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
 def backend_supports_donation() -> bool:
     """Whether ``donate_argnums`` actually reuses buffers here.
 

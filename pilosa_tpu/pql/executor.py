@@ -40,6 +40,7 @@ from pilosa_tpu.core.schema import FieldType
 from pilosa_tpu.core.stacked import StackedBSI, StackedSet, stacked_bsi, stacked_set
 from pilosa_tpu.ops import bitmap as B
 from pilosa_tpu.ops import bsi as S
+from pilosa_tpu.ops import topk as T
 from pilosa_tpu.ops.groupby import pair_counts, pair_sums
 from pilosa_tpu.pql.ast import Call, Condition, Query, ROW_OPTIONS, unwrap_options
 from pilosa_tpu.pql.parser import parse
@@ -916,7 +917,10 @@ class Executor:
             for s in stacks:
                 sel = s.take_rows(chunk)
                 merged = sel if merged is None else jnp.bitwise_or(merged, sel)
-            parts.append(sync_part(B.row_counts(merged, filt)))
+            # TopN ranking counts ride the Pallas MXU row-count kernel
+            # when eligible (ops/topk.py dispatcher; classic reduce
+            # otherwise — bit-identical either way)
+            parts.append(sync_part(T.row_counts(merged, filt)))
         return row_ids, _concat(parts)
 
     def _pairs_field(self, field: Field, ranked: List[Tuple[int, int]]

@@ -38,6 +38,7 @@ from pilosa_tpu.core import timeq
 from pilosa_tpu.obs import devprof
 from pilosa_tpu.core.stacked import stacked_set
 from pilosa_tpu.ops import bitmap as B
+from pilosa_tpu.ops import pallas_util as PU
 from pilosa_tpu.pql.ast import Condition, ROW_OPTIONS
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
@@ -68,7 +69,12 @@ def _program(kind: str, tape: Tuple, n_leaves: int, masked: bool,
              total_words: int):
     from pilosa_tpu.parallel import mesh
 
-    key = (kind, tape, n_leaves, masked, total_words, mesh.mesh_epoch())
+    # Count terminals may route to the Pallas popcount-reduce; the mode
+    # token tracks the routing decision (kill switch / forced interpret
+    # / strike-out) so flipping it can't serve a stale executable.
+    token = PU.mode_token() if kind == "count" else None
+    key = (kind, tape, n_leaves, masked, total_words, mesh.mesh_epoch(),
+           token)
     with _PROGRAMS_LOCK:
         fn = _PROGRAMS.get(key)
         if fn is not None:
@@ -233,11 +239,23 @@ def run_count(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:
     total_words = len(shard_list) * WORDS_PER_SHARD
     masked = mask is not None
     fn = _program("count", tape, len(leaves), masked, total_words)
-    if masked:
-        return _invoke("count", tape, len(leaves), True, total_words,
-                       fn, *leaves, mask.plane)
-    return _invoke("count", tape, len(leaves), False, total_words,
-                   fn, *leaves)
+    args = (*leaves, mask.plane) if masked else tuple(leaves)
+    try:
+        out = _invoke("count", tape, len(leaves), masked, total_words,
+                      fn, *args)
+    except Exception as e:
+        if not getattr(fn, "pallas_terminal", False):
+            raise
+        # One strike pins the terminal to the classic reduce: a Pallas
+        # lowering bug here would otherwise fail every count family.
+        PU.disable_kernel("tape_count")
+        PU.failed("tape_count", e)
+        fn = _program("count", tape, len(leaves), masked, total_words)
+        out = _invoke("count", tape, len(leaves), masked, total_words,
+                      fn, *args)
+    if getattr(fn, "pallas_terminal", False):
+        PU.dispatched("tape_count")
+    return out
 
 
 def run_plane(ex, idx, call, shard_list: List[int], mask) -> Optional[object]:

@@ -152,6 +152,12 @@ def _selftest(threshold: float) -> int:
         "c19_dax_fresh_node_read_p99 (cpu)":
             {"metric": "c19_dax_fresh_node_read_p99 (cpu)", "value": 40.0,
              "unit": "ms", "vs_baseline": 1.2},
+        # the pallas kernel-plane gate emits a verified-family count:
+        # a DROP means a kernel fell off the pallas path (or parity
+        # broke), which must gate like any throughput metric
+        "c20_pallas_parity (cpu)":
+            {"metric": "c20_pallas_parity (cpu)", "value": 6.0,
+             "unit": "families", "vs_baseline": 1.0},
     }
     same = compare(base, base, threshold)
     assert same and not any(r["regressed"] for r in same), \
@@ -161,10 +167,12 @@ def _selftest(threshold: float) -> int:
     slow["c13_resident_warm_p50 (cpu)"]["value"] = 12.0   # ms up 20%
     slow["c1_ingest (cpu)"]["value"] = 400000.0           # rows/s down 20%
     slow["c19_dax_fresh_node_read_p99 (cpu)"]["value"] = 48.0  # ms up 20%
+    slow["c20_pallas_parity (cpu)"]["value"] = 4.0    # families down 33%
     rows = compare(base, slow, threshold)
     bad = {r["metric"] for r in rows if r["regressed"]}
     assert bad == {"c13_resident_warm_p50", "c1_ingest",
-                   "c19_dax_fresh_node_read_p99"}, bad
+                   "c19_dax_fresh_node_read_p99",
+                   "c20_pallas_parity"}, bad
     # a 10% drift stays under the default 15% gate
     drift = {k: dict(v) for k, v in base.items()}
     drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0

@@ -227,6 +227,37 @@ echo "== elastic serverless bench gate (bench.py --configs 19) =="
 # before ack).
 JAX_PLATFORMS=cpu python bench.py --configs 19 || exit $?
 
+echo "== pallas-interpret lane (PILOSA_TPU_PALLAS=1) =="
+# Every Pallas kernel body executes on CPU via interpret=True across the
+# ops, resident, and fusion suites plus the dedicated parity battery:
+# results must stay bit-identical to the classic XLA paths those same
+# suites assert. Widths above pallas_util.INTERPRET_MAX_WORDS stay on
+# the classic path (why="interpret") — the interpreter adds no kernel
+# coverage at shard scale and costs seconds per dispatch.
+PILOSA_TPU_PALLAS=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_topk_groupby.py tests/test_bsi.py \
+    tests/test_resident.py tests/test_fusion.py \
+    tests/test_pallas_parity.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== pallas kill-switch lane (PILOSA_TPU_PALLAS=0) =="
+# The same ops suites with the kill switch engaged: classic path
+# everywhere, and the parity battery's kill-switch tests assert zero
+# dispatches and zero fallback ticks (the switch must cost nothing).
+PILOSA_TPU_PALLAS=0 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_topk_groupby.py tests/test_bsi.py \
+    tests/test_pallas_parity.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== pallas parity/speedup bench gate (bench.py --configs 20) =="
+# Hard-asserts the ISSUE 17 acceptance bar in-process: kill switch ->
+# zero dispatches and zero counter ticks; forced -> every kernel family
+# (pair counts, BSI sum/compare, TopN, ingest scatter, tape terminal)
+# dispatches Pallas and returns results bit-identical to the classic
+# oracle; on TPU backends the wide-shape phase additionally hard-asserts
+# >= 1.3x p50 speedup (CPU runs time it unenforced under interpret).
+JAX_PLATFORMS=cpu python bench.py --configs 20 || exit $?
+
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver
 # wrappers when present. CI gates fatally against a pinned baseline.

@@ -115,6 +115,36 @@ class TestCostModel:
         assert devprof.shape_bucket(1024) == 1024
         assert devprof.shape_bucket(1025) == 2048
 
+    def test_pallas_mm_cost(self):
+        # C[2, 14] matmul contracting 32*4096 lanes: 2*2*14*32*4096
+        # FLOPs; HBM = (2+14) packed planes * 4B * 4096 + int32 result
+        flops, hbm = devprof.tape_cost(
+            "pallas", (("mm", 2, 14),), 16, False, 4096)
+        assert flops == 2.0 * 2 * 14 * 32 * 4096
+        assert hbm == 4.0 * 16 * 4096 + 4.0 * 2 * 14
+
+    def test_pallas_cmp_cost(self):
+        # depth=13 planes x 1 constant side: (6*13*1 + 8) word-ops * 32
+        # lanes * 512 words; HBM reads exists+sign+result + 13 mags
+        flops, hbm = devprof.tape_cost(
+            "pallas", (("cmp", 13, 1),), 15, False, 512)
+        assert flops == 32.0 * (6 * 13 + 8) * 512
+        assert hbm == 4.0 * (3 + 13) * 512
+
+    def test_pallas_scatter_cost(self):
+        flops, hbm = devprof.tape_cost(
+            "pallas", (("scatter", 300, 8),), 2, False, 8192)
+        assert flops == 32.0 * 2 * 8192   # or-merge + popcount-andnot
+        assert hbm == 4.0 * 3 * 8192      # planes + updates in, merged out
+
+    def test_pallas_unknown_family_raises(self):
+        with pytest.raises(ValueError):
+            devprof.tape_cost("pallas", (("bogus", 1, 1),), 1, False, 64)
+
+    def test_pallas_family_name(self):
+        fam = devprof.family_name("pallas", (("mm", 2, 14),), 16, False)
+        assert fam.startswith("pallas/16l/mm1#")
+
 
 # ---------------------------------------------------------------------------
 # KernelProfileRegistry + IngestAccounting
