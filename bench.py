@@ -2215,6 +2215,140 @@ def bench_config20(device: str) -> None:
           speedup=speedup, speedup_gated=on_tpu)
 
 
+def bench_config21(device: str) -> None:
+    """Compressed-residency gate: three phases over one sparse workload
+    (clustered rows — each row lights up 1-2 word tiles of a wide block,
+    the high-cardinality shape the DeviceBudget LRU thrashes on when
+    every block is dense).
+
+    1. kill switch (``PILOSA_TPU_COMPRESS=0``) — HARD asserts:
+       ``maybe_compress`` returns None, zero compress-metric movement,
+       zero ``ctile_count`` dispatches. These results are the dense
+       oracle.
+    2. forced (``PILOSA_TPU_COMPRESS=1``) — same blocks compressed;
+       HARD asserts: bit-identical decode, row_counts (plain and
+       filtered) and BSI compare vs the oracle, AND the headline: >= 10x
+       resident rows under the same DeviceBudget byte cap.
+    3. scan p50 — tile-skipping compressed scan vs the dense scan on the
+       same sparse rows. On TPU HARD assert no worse (>= 1.0x); on CPU
+       the ratio is emitted ungated (interpret/XLA-gather costs differ).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.core import stacked as stx
+    from pilosa_tpu.obs import metrics as obs_metrics
+    from pilosa_tpu.ops import bitmap as B
+    from pilosa_tpu.ops import bsi as S
+    from pilosa_tpu.ops import ctiles as C
+    from pilosa_tpu.ops import pallas_util as PU
+
+    rng = np.random.default_rng(21)
+    rows = _n(256)
+    words = 1 << 14  # unscaled: the per-row width is the compression axis
+    host = np.zeros((rows, words), dtype=np.uint32)
+    t = C.tile_words(words)
+    for r in range(rows):
+        # 1-2 clustered runs per row, each within one tile
+        for _ in range(int(rng.integers(1, 3))):
+            tile = int(rng.integers(0, words // t))
+            lo = tile * t + int(rng.integers(0, t - 16))
+            n = int(rng.integers(4, 16))
+            host[r, lo:lo + n] = rng.integers(1, 1 << 32, n,
+                                              dtype=np.uint32)
+    filt = rng.integers(0, 1 << 32, words, dtype=np.uint32)
+    depth = 6
+    bcols = np.unique(rng.integers(0, words * 32, 2000))
+    bvals = rng.integers(-30, 30, bcols.size)
+    bsi_host = np.asarray(S.encode_values(bcols, bvals, depth, words))
+
+    reg = obs_metrics.REGISTRY
+
+    def compress_series():
+        snap = reg.snapshot()
+        return {k: v for section in ("counters", "gauges")
+                for k, v in snap[section].items()
+                if k.startswith("device_compress")}
+
+    def ctile_dispatches():
+        snap = reg.snapshot()["counters"]
+        return sum(v for k, v in snap.items()
+                   if k.startswith(obs_metrics.METRIC_OPS_PALLAS_DISPATCH)
+                   and "ctile" in k)
+
+    saved = os.environ.get("PILOSA_TPU_COMPRESS")
+    PU.reset_failures()
+    try:
+        # -- phase 1: kill switch — dense oracle, zero-overhead gate -------
+        os.environ["PILOSA_TPU_COMPRESS"] = "0"
+        series0 = compress_series()
+        d0 = ctile_dispatches()
+        assert C.maybe_compress(host, kind="set") is None
+        assert C.maybe_compress(bsi_host, kind="bsi") is None
+        dense = jnp.asarray(host)
+        oracle_counts = np.asarray(B.row_counts(dense))
+        oracle_filt = np.asarray(B.row_counts(dense, jnp.asarray(filt)))
+        oracle_cmp = np.asarray(S.bsi_compare(
+            jnp.asarray(bsi_host), S.BETWEEN, -10, 10))
+        assert compress_series() == series0, \
+            "kill switch moved a compress metric"
+        assert ctile_dispatches() == d0, \
+            "kill switch dispatched the compressed-scan kernel"
+
+        # -- phase 2: forced — bit-identity + the 10x residency headline ---
+        os.environ["PILOSA_TPU_COMPRESS"] = "1"
+        cb = C.maybe_compress(host, kind="set")
+        bcb = C.maybe_compress(bsi_host, kind="bsi")
+        assert cb is not None and bcb is not None
+        np.testing.assert_array_equal(np.asarray(cb.decode()), host)
+        np.testing.assert_array_equal(
+            np.asarray(cb.row_counts()), oracle_counts)
+        np.testing.assert_array_equal(
+            np.asarray(cb.row_counts(jnp.asarray(filt))), oracle_filt)
+        np.testing.assert_array_equal(
+            np.asarray(C.bsi_compare_compressed(bcb, S.BETWEEN, -10, 10)),
+            oracle_cmp)
+        # residency: rows resident under the SAME DeviceBudget byte cap
+        cap = stx.BUDGET.cap
+        dense_rows_resident = cap // (words * 4)
+        comp_rows_resident = cap * rows // max(cb.nbytes, 1)
+        rows_ratio = comp_rows_resident / max(dense_rows_resident, 1)
+        assert rows_ratio >= 10.0, (
+            f"compressed residency {rows_ratio:.1f}x < 10x "
+            f"(stored {cb.nbytes} vs dense {cb.dense_nbytes})")
+
+        # -- phase 3: scan p50, compressed vs dense (gated on TPU) ---------
+        jfilt = jnp.asarray(filt)
+
+        def dense_scan():
+            jax.block_until_ready(B.row_counts(dense, jfilt))
+
+        def compressed_scan():
+            jax.block_until_ready(cb.row_counts(jfilt))
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        dense_ms = _p50_ms(dense_scan)
+        comp_ms = _p50_ms(compressed_scan)
+        scan_ratio = dense_ms / max(comp_ms, 1e-9)
+        if on_tpu:
+            assert scan_ratio >= 1.0, (
+                f"compressed scan {comp_ms:.3f}ms slower than dense "
+                f"{dense_ms:.3f}ms on sparse rows")
+    finally:
+        if saved is None:
+            os.environ.pop("PILOSA_TPU_COMPRESS", None)
+        else:
+            os.environ["PILOSA_TPU_COMPRESS"] = saved
+        PU.reset_failures()
+
+    _emit(f"c21_compress_resident_rows{SCALED} ({device})",
+          float(rows_ratio), "x", float(rows_ratio),
+          stored_bytes=int(cb.nbytes), dense_bytes=int(cb.dense_nbytes),
+          bytes_ratio=float(cb.dense_nbytes) / max(cb.nbytes, 1),
+          dense_scan_ms=dense_ms, compressed_scan_ms=comp_ms,
+          scan_ratio=scan_ratio, scan_gated=on_tpu)
+
+
 _CONFIGS = {
     "1": bench_config1,
     "2": bench_config2,
@@ -2235,6 +2369,7 @@ _CONFIGS = {
     "18": bench_config18,
     "19": bench_config19,
     "20": bench_config20,
+    "21": bench_config21,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

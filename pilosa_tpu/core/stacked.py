@@ -56,9 +56,33 @@ import numpy as np
 from pilosa_tpu import platform
 from pilosa_tpu.ops import bitmap as bitops
 from pilosa_tpu.ops import bsi as bsiops
+from pilosa_tpu.ops import ctiles
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
 _MIN_SLOTS = 8
+
+#: a resident block is either a dense device tensor or a compressed-tile
+#: block (ops/ctiles.py) — consumers that need dense words go through
+#: :func:`_dense`, scans dispatch on the type for the tile-skipping path
+Block = object
+
+
+def _dense(blk) -> jax.Array:
+    """Dense ``uint32[R, W]`` view of a resident block: identity for
+    dense tensors, a device-side gather (no host staging) for
+    compressed ones."""
+    if isinstance(blk, ctiles.CompressedBlock):
+        return blk.decode()
+    return blk
+
+
+def _take(blk, src) -> jax.Array:
+    """Row-subset gather from a resident block (decodes only the
+    requested rows of a compressed block)."""
+    src = np.asarray(src, dtype=np.int32)
+    if isinstance(blk, ctiles.CompressedBlock):
+        return blk.decode(rows=src)
+    return jnp.take(blk, jnp.asarray(src), axis=0)
 
 
 # Full-stack uploads (host -> device transfers of whole stacked tensors or
@@ -177,8 +201,11 @@ class DeviceBudget:
                 self.used -= b
                 PAGING_STATS["evictions"] += 1
                 M.REGISTRY.count(M.METRIC_DEVICE_STACK_EVICTIONS)
+                M.REGISTRY.count(M.METRIC_DEVICE_BUDGET_EVICTIONS)
                 cb()
             M.REGISTRY.gauge(M.METRIC_DEVICE_HBM_RESIDENT_BYTES, self.used)
+            M.REGISTRY.gauge(M.METRIC_DEVICE_BUDGET_RESIDENT_BYTES,
+                             self.used)
 
     def touch(self, key: Tuple) -> None:
         with self._lock:
@@ -193,6 +220,8 @@ class DeviceBudget:
                 from pilosa_tpu.obs import metrics as M
 
                 M.REGISTRY.gauge(M.METRIC_DEVICE_HBM_RESIDENT_BYTES,
+                                 self.used)
+                M.REGISTRY.gauge(M.METRIC_DEVICE_BUDGET_RESIDENT_BYTES,
                                  self.used)
 
     def audit(self) -> None:
@@ -256,7 +285,8 @@ class StackedSet:
         self._fragments = list(fragments)
         self._built_vers = tuple(
             -1 if f is None else f.version for f in fragments)
-        self._blocks: List[Optional[jax.Array]] = (
+        # entries are dense jax tensors OR ctiles.CompressedBlock
+        self._blocks: List[Optional[object]] = (
             [None] * (self.cap // self.block_rows))
         self._lock = threading.Lock()
         # request-scoped stacks (built inside a write Qcx, never
@@ -279,8 +309,9 @@ class StackedSet:
     def n_blocks(self) -> int:
         return len(self._blocks)
 
-    def _build_block_host(self, bi: int) -> jax.Array:
-        """Assemble block ``bi`` from the host fragment planes and upload.
+    def _build_block_host(self, bi: int):
+        """Assemble block ``bi`` from the host fragment planes and upload
+        (compressed-tile form when the policy says so, dense otherwise).
         Caller must have validated the version snapshot (or hold the
         writer lock through the build, as __init__/advance do)."""
         from pilosa_tpu.obs.tracing import get_tracer
@@ -306,9 +337,14 @@ class StackedSet:
                         host[slot - lo_slot, lo:lo + self.words] = \
                             frag.planes[fslot]
             PAGING_STATS["block_builds"] += 1
+            cb = ctiles.maybe_compress(host, kind="set")
+            if cb is not None:
+                UPLOAD_STATS["count"] += 1
+                UPLOAD_STATS["bytes"] += cb.nbytes
+                return cb
             return _engine_put(host)
 
-    def _ensure_block(self, bi: int) -> jax.Array:
+    def _ensure_block(self, bi: int):
         blk = self._blocks[bi]
         if blk is not None:
             BUDGET.touch((self.serial, bi))
@@ -345,10 +381,16 @@ class StackedSet:
         # next touch lazily rebuilds under the version check
         self._blocks[bi] = None
 
+    def _block_dense(self, bi: int) -> jax.Array:
+        """Block ``bi`` as a dense device tensor (decoded on the fly when
+        resident in compressed form — no host transfer)."""
+        return _dense(self._ensure_block(bi))
+
     def iter_blocks(self) -> Iterator[Tuple[int, jax.Array]]:
-        """(start_slot, device block) over all blocks, built on demand."""
+        """(start_slot, dense device block) over all blocks, built on
+        demand; compressed-resident blocks decode device-side."""
         for bi in range(self.n_blocks):
-            yield bi * self.block_rows, self._ensure_block(bi)
+            yield bi * self.block_rows, self._block_dense(bi)
 
     # -- single-tensor view (unpaged fast path) -------------------------------
 
@@ -359,7 +401,7 @@ class StackedSet:
         if self.paged:
             raise AssertionError(
                 "paged stack has no single tensor; use iter_blocks()")
-        return self._ensure_block(0)
+        return self._block_dense(0)
 
     # -- reads ----------------------------------------------------------------
 
@@ -373,6 +415,8 @@ class StackedSet:
         if slot is None:
             return self.zero_plane()
         blk = self._ensure_block(slot // self.block_rows)
+        if isinstance(blk, ctiles.CompressedBlock):
+            return blk.decode(rows=[slot % self.block_rows])[0]
         return blk[slot % self.block_rows]
 
     def take_rows(self, rows: Sequence[int]) -> jax.Array:
@@ -394,11 +438,10 @@ class StackedSet:
             bi, (dst, src) = next(iter(by_block.items()))
             blk = self._ensure_block(bi)
             order = np.argsort(dst)
-            return jnp.take(blk, jnp.asarray(np.asarray(src)[order]), axis=0)
+            return _take(blk, np.asarray(src)[order])
         out = jnp.zeros((n, self.total_words), dtype=jnp.uint32)
         for bi, (dst, src) in by_block.items():
-            blk = self._ensure_block(bi)
-            sel = jnp.take(blk, jnp.asarray(src, dtype=jnp.int32), axis=0)
+            sel = _take(self._ensure_block(bi), src)
             out = out.at[jnp.asarray(dst, dtype=jnp.int32)].set(sel)
         return out
 
@@ -414,8 +457,7 @@ class StackedSet:
             return self.zero_plane()
         acc = None
         for bi, slots in sorted(by_block.items()):
-            blk = self._ensure_block(bi)
-            sel = jnp.take(blk, jnp.asarray(slots, dtype=jnp.int32), axis=0)
+            sel = _take(self._ensure_block(bi), slots)
             part = jax.lax.reduce(
                 sel, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0,))
             acc = part if acc is None else jnp.bitwise_or(acc, part)
@@ -427,8 +469,15 @@ class StackedSet:
         streamed per block (reference: fragment.go:1317 top counts)."""
         from pilosa_tpu.ops import topk as topkops
 
-        parts = [sync_part(topkops.row_counts(blk, filt))
-                 for _, blk in self.iter_blocks()]
+        parts = []
+        for bi in range(self.n_blocks):
+            blk = self._ensure_block(bi)
+            if isinstance(blk, ctiles.CompressedBlock):
+                # tile-skipping scan: zero/run tiles never reach the
+                # kernel, bit-identical to the dense path
+                parts.append(sync_part(blk.row_counts(filt)))
+            else:
+                parts.append(sync_part(topkops.row_counts(blk, filt)))
         return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
@@ -461,7 +510,7 @@ class StackedBSI:
         self._planes: Optional[jax.Array] = self._build_host()
         self._charge()
 
-    def _build_host(self) -> jax.Array:
+    def _build_host(self):
         from pilosa_tpu.obs.tracing import get_tracer
 
         with get_tracer().start_span(
@@ -474,6 +523,11 @@ class StackedBSI:
                     continue
                 lo = si * self.words
                 host[: frag.planes.shape[0], lo:lo + self.words] = frag.planes
+            cb = ctiles.maybe_compress(host, kind="bsi")
+            if cb is not None:
+                UPLOAD_STATS["count"] += 1
+                UPLOAD_STATS["bytes"] += cb.nbytes
+                return cb
             return _engine_put(host)
 
     def _charge(self) -> None:
@@ -488,15 +542,15 @@ class StackedBSI:
     def release_device(self) -> None:
         BUDGET.release((self.serial, 0))
 
-    @property
-    def planes(self) -> jax.Array:
+    def _entry(self):
+        """The resident entry (dense tensor OR compressed block),
+        rebuilding an evicted one under the writer lock with the version
+        check (same protocol as StackedSet._ensure_block — a torn or
+        stale rebuild must never serve a read)."""
         blk = self._planes
         if blk is not None:
             BUDGET.touch((self.serial, 0))
             return blk
-        # evicted: rebuild under the writer lock with the version check
-        # (same protocol as StackedSet._ensure_block — a torn or stale
-        # rebuild must never serve a read)
         with self._write_lock, self._lock:
             blk = self._planes
             if blk is not None:
@@ -510,6 +564,21 @@ class StackedBSI:
             self._planes = blk
         self._charge()
         return blk
+
+    @property
+    def planes(self) -> jax.Array:
+        return _dense(self._entry())
+
+    def compare(self, op: str, value: int,
+                value2: Optional[int] = None) -> jax.Array:
+        """Range compare over this stack. On a compressed-resident stack
+        the scan narrows to active tiles (ops/ctiles.py) — sound because
+        every ``bsi_compare`` output is EXISTS-masked, so all-zero tiles
+        contribute exactly the zeros the scatter leaves behind."""
+        blk = self._entry()
+        if isinstance(blk, ctiles.CompressedBlock):
+            return ctiles.bsi_compare_compressed(blk, op, value, value2)
+        return bsiops.bsi_compare(blk, op, value, value2)
 
     def exists_plane(self) -> jax.Array:
         return self.planes[bsiops.EXISTS]
@@ -791,6 +860,9 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
         blk = stack._blocks[0]
         if blk is None:
             return None  # resident block was evicted: rebuild from host
+        # write-hot compressed blocks decay to dense (device-side decode,
+        # no host transfer); the next full rebuild recompresses
+        blk = _dense(blk)
         if new.cap > stack.cap:
             blk = _grow_rows_device(blk, new.cap - stack.cap)
         blk = acc.apply(blk, 0, new.cap)
@@ -816,7 +888,14 @@ def _advance_set(stack: "StackedSet", fragments, built_vers) -> Optional["Stacke
         if blk is None:
             continue
         lo_slot = bi * new.block_rows
-        blocks[bi] = acc.apply(blk, lo_slot, lo_slot + new.block_rows)
+        hi_slot = lo_slot + new.block_rows
+        if isinstance(blk, ctiles.CompressedBlock):
+            if not any(lo_slot <= k[0] < hi_slot for k in acc.masks):
+                continue  # untouched by the deltas: stays compressed
+            # touched: decay to dense device-side; recompressed on the
+            # next full rebuild
+            blk = _dense(blk)
+        blocks[bi] = acc.apply(blk, lo_slot, hi_slot)
     # _blocks must exist before any charge: an eviction cascade can pop
     # one of new's OWN earlier entries, whose callback reads _blocks
     new._blocks = blocks
@@ -838,6 +917,9 @@ def _advance_bsi(stack: "StackedBSI", fragments, built_vers) -> Optional["Stacke
     base = stack._planes
     if base is None:
         return None
+    # a compressed-resident tensor decays to dense under writes (decode
+    # is device-side); the next full rebuild recompresses
+    base = _dense(base)
     n_planes = base.shape[0]
     acc = _MaskAccum()
     for si, (frag, built_v) in enumerate(zip(fragments, built_vers)):

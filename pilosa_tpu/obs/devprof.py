@@ -162,6 +162,12 @@ def tape_cost(kind: str, tape: Tuple, n_leaves: int, masked: bool,
         elif op == "scatter":
             flops = float(BIT_LANES) * 2.0 * total_words
             hbm = float(WORD_BYTES) * 3.0 * total_words
+        elif op == "pop":
+            # ctile_count: per-row popcount reduce over d1 payload tiles
+            # of total_words words each; reads the packed payload, writes
+            # one int32 per tile
+            flops = float(BIT_LANES) * 2.0 * d1 * total_words
+            hbm = float(WORD_BYTES) * d1 * total_words + 4.0 * d1
         else:
             raise ValueError(f"unknown pallas cost family {op!r}")
         return flops, hbm

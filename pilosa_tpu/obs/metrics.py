@@ -172,6 +172,23 @@ TRACE_DURATION_BUCKETS_MS = (0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
 METRIC_DEVICE_HBM_RESIDENT_BYTES = "device_hbm_resident_bytes"
 METRIC_DEVICE_STACK_EVICTIONS = "device_stack_evictions_total"
 METRIC_DEVICE_RESIDENT_HITS = "device_resident_hits_total"
+# DeviceBudget's own accounting exported directly (same numbers the LRU
+# enforces): bytes currently charged against the HBM cap, and entries it
+# has evicted to stay under it
+METRIC_DEVICE_BUDGET_RESIDENT_BYTES = "device_budget_resident_bytes"
+METRIC_DEVICE_BUDGET_EVICTIONS = "device_budget_evictions_total"
+# compressed-residency plane (ops/ctiles.py): blocks stored in
+# compressed-tile form (labelled kind=set|bsi), blocks kept dense and
+# why (disabled is never ticked — the kill switch costs nothing),
+# cumulative dense-vs-stored bytes (the corpus-level compression win),
+# the last block's dense/stored ratio, and zero/run tiles skipped by
+# compressed scans instead of being read
+METRIC_COMPRESS_BLOCKS = "device_compress_blocks_total"
+METRIC_COMPRESS_FALLBACK = "device_compress_fallback_total"
+METRIC_COMPRESS_DENSE_BYTES = "device_compress_dense_bytes_total"
+METRIC_COMPRESS_STORED_BYTES = "device_compress_stored_bytes_total"
+METRIC_COMPRESS_RATIO = "device_compress_ratio"
+METRIC_COMPRESS_TILES_SKIPPED = "device_compress_tiles_skipped_total"
 # cluster health plane (obs/timeline.py + slo.py + flight.py): samples
 # appended to the in-memory timeline ring, per-objective error-budget
 # burn rate over the fast/slow windows (gauge {slo=,window=}), and

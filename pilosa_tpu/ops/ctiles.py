@@ -1,0 +1,440 @@
+"""Block-compressed device-resident bitmap tiles.
+
+The reference engine lives on roaring compression (storage/roaring.py's
+array/bitmap/run containers); our device planes are dense
+``uint32[R, S*W]`` blocks, so the :class:`~pilosa_tpu.core.stacked.DeviceBudget`
+LRU caps resident data far below a million-user corpus. This module is
+the resident-format half of that gap: each row block is chunked into
+fixed-size **word tiles** and every (row, tile) is classified with a
+roaring-style container tag —
+
+* ``zero``  — all words 0 (the overwhelmingly common case for sparse
+  rows): no payload, skipped entirely by scans;
+* ``run``   — all words equal to one non-zero constant (roaring's run
+  container at word granularity; 0xFFFFFFFF runs are dense ranges):
+  one uint32 of storage;
+* ``dense`` — anything else: the tile's words are stored verbatim in a
+  packed payload.
+
+Device layout (one :class:`CompressedBlock` per row block)::
+
+    payload      uint32[P, T]      dense-tile words, packed, row-major
+    slot         int32[R, NT]      payload index per (row, tile); -1 = const
+    const        uint32[R, NT]     the constant word of zero/run tiles
+    payload_row  int32[P]          owning row of each payload entry
+    payload_tile int32[P]          tile column of each payload entry
+
+``payload_row``/``payload_tile`` are the *skip index*: a scan touches
+exactly the P dense tiles and reconstitutes per-row results with one
+scatter-add — zero/run tiles never reach the kernel. Decode is a single
+jitted gather (``take`` + ``where``) that runs device-side, so an
+evicted-free warm query never re-stages from the host.
+
+Classification happens host-side in ``StackedSet._build_block_host`` /
+``StackedBSI._build_host`` where the dense host block already exists;
+only the compressed arrays cross PCIe.
+
+Policy (``PILOSA_TPU_COMPRESS``): unset — compress when the dense block
+is at least :data:`MIN_BYTES` and compression actually wins
+(:data:`MAX_RATIO`); ``0`` — kill switch, dense everywhere with zero
+overhead (no classification, no metric ticks); ``1`` — force, compress
+every block regardless of size/ratio/mesh (the CI parity vehicle —
+GSPMD keeps mixed placements bit-identical, so forcing on a mesh trades
+only performance). In auto mode, blocks on a multi-device engine mesh
+stay dense (``why="mesh"``): compressed arrays are placed unsharded and
+would otherwise mix placements with mesh-sharded dense planes on every
+scan.
+
+The per-payload popcount rides a dedicated Pallas VPU kernel
+(``ctile_count``) behind the shared ops/pallas_util.py
+eligibility/strike-out policy, with a jitted XLA path as the
+bit-identity oracle — and the fully-dense classic path above both.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from pilosa_tpu import platform
+from pilosa_tpu.obs import metrics as M
+from pilosa_tpu.ops import pallas_util as PU
+
+#: words per tile. 512 uint32 = 2 KiB = 16384 columns per tile — wide
+#: enough that slot/const overhead is ~0.4% of dense, narrow enough that
+#: a handful of set bits doesn't densify a whole shard row. Narrow
+#: blocks shrink the tile to the block width (pow2, floor 8).
+TILE_WORDS = 512
+
+#: dense blocks below this stay dense by default: classification +
+#: indirect decode isn't worth it for data that fits HBM thousands of
+#: times over (PILOSA_TPU_COMPRESS=1 overrides for tests).
+MIN_BYTES = 1 << 16
+
+#: keep the compressed form only when it actually wins: stored bytes
+#: must be at most this fraction of dense, else the block stays dense
+#: (why="ratio") — a mostly-dense block must not pay decode for nothing.
+MAX_RATIO = 0.9
+
+_OFF = ("0", "false", "no", "off")
+_ON = ("1", "true", "yes", "on", "force")
+
+
+def _env() -> str:
+    return os.environ.get("PILOSA_TPU_COMPRESS", "").strip().lower()
+
+
+def disabled() -> bool:
+    """Kill switch engaged (``PILOSA_TPU_COMPRESS=0``): every block stays
+    dense and this module does no work at all — not even a counter tick."""
+    return _env() in _OFF and _env() != ""
+
+
+def forced() -> bool:
+    """Compression forced regardless of size/ratio
+    (``PILOSA_TPU_COMPRESS=1``) — the CI parity vehicle."""
+    return _env() in _ON
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def tile_words(width: int) -> int:
+    """Tile size for a block of ``width`` words: the configured tile,
+    shrunk (pow2, floor 8) for blocks narrower than one tile."""
+    t = _env_int("PILOSA_TPU_COMPRESS_TILE_WORDS", TILE_WORDS)
+    if width >= t:
+        return t
+    p = 8
+    while p < width:
+        p <<= 1
+    return min(p, t)
+
+
+def why_not_compress(dense_nbytes: int) -> Optional[str]:
+    """``None`` when a freshly built block of ``dense_nbytes`` should be
+    classified for compression, else the reason it stays dense:
+    ``disabled`` | ``small`` | ``mesh``. The ratio rule is applied after
+    classification (it needs the actual stored size)."""
+    if disabled():
+        return "disabled"
+    if forced():
+        # the CI parity vehicle: size, ratio and mesh rules all yield.
+        # GSPMD keeps mixed-placement consumers bit-identical, so forcing
+        # on a mesh trades only performance, never correctness.
+        return None
+    if _env_int("PILOSA_TPU_COMPRESS_MIN_BYTES", MIN_BYTES) \
+            > dense_nbytes:
+        return "small"
+    from pilosa_tpu.parallel.mesh import engine_mesh
+
+    if engine_mesh().devices.size > 1:
+        return "mesh"
+    return None
+
+
+def _fallback(why: str, kind: str) -> None:
+    # mirror pallas_util: the kill switch must cost nothing, not even a tick
+    if why != "disabled":
+        M.REGISTRY.count(M.METRIC_COMPRESS_FALLBACK, why=why, kind=kind)
+
+
+class CompressedBlock:
+    """One row block in compressed-tile form (device arrays + host
+    metadata). Immutable once built — the write-merge advance path
+    decodes to dense instead of patching payloads."""
+
+    __slots__ = ("rows", "words", "tile_words", "n_tiles", "payload",
+                 "slot", "const", "payload_row", "payload_tile",
+                 "n_payload", "nbytes", "dense_nbytes", "zero_tiles",
+                 "run_tiles", "dense_tiles", "const_uniform",
+                 "active_tiles")
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.rows, self.words)
+
+    @property
+    def dtype(self):
+        return jnp.uint32
+
+    def decode(self, rows: Optional[Sequence] = None) -> jax.Array:
+        """Dense ``uint32[R, words]`` (or a row subset) rebuilt
+        device-side — the bit-identity oracle every consumer can fall
+        back to, and the advance path's write target."""
+        if rows is None:
+            return _decode(self.payload, self.slot, self.const, self.words)
+        idx = jnp.asarray(np.asarray(rows, dtype=np.int32))
+        return _decode(self.payload, self.slot[idx], self.const[idx],
+                       self.words)
+
+    def row_counts(self, filt=None) -> jax.Array:
+        """Per-row popcounts (optionally AND ``filt`` first) touching
+        only dense payload tiles + a constant-tile closed form — the
+        tile-skipping scan. Bit-identical to
+        ``bitmap.row_counts(self.decode(), filt)``."""
+        return _compressed_row_counts(self, filt)
+
+
+def classify(host: np.ndarray, t: Optional[int] = None):
+    """Host half: tile + tag a dense ``uint32[R, W]`` block. Returns the
+    packed numpy arrays and tag counts (everything :func:`maybe_compress`
+    needs to build a :class:`CompressedBlock`)."""
+    rows, width = host.shape
+    t = t or tile_words(width)
+    n_tiles = -(-width // t)
+    if width == n_tiles * t:
+        tiles = np.ascontiguousarray(host).reshape(rows, n_tiles, t)
+    else:
+        tiles = np.zeros((rows, n_tiles * t), dtype=np.uint32)
+        tiles[:, :width] = host
+        tiles = tiles.reshape(rows, n_tiles, t)
+    const_ok = np.all(tiles == tiles[..., :1], axis=-1)
+    const = np.where(const_ok, tiles[..., 0], np.uint32(0)).astype(np.uint32)
+    dense_mask = ~const_ok
+    payload_row, payload_tile = np.nonzero(dense_mask)
+    payload = tiles[payload_row, payload_tile]
+    slot = np.full((rows, n_tiles), -1, dtype=np.int32)
+    slot[dense_mask] = np.arange(payload_row.size, dtype=np.int32)
+    zero = int(np.count_nonzero(const_ok & (const == 0)))
+    run = int(np.count_nonzero(const_ok) - zero)
+    return (payload, slot, const,
+            payload_row.astype(np.int32), payload_tile.astype(np.int32),
+            t, n_tiles, zero, run, int(payload_row.size))
+
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+def maybe_compress(host: np.ndarray, kind: str) -> Optional[CompressedBlock]:
+    """Classify + upload ``host`` as a :class:`CompressedBlock`, or
+    ``None`` when the block should stay dense (policy or ratio). ``kind``
+    labels the metrics (``set`` | ``bsi``)."""
+    why = why_not_compress(host.nbytes)
+    if why is not None:
+        _fallback(why, kind)
+        return None
+    (payload, slot, const, payload_row, payload_tile,
+     t, n_tiles, zero, run, n_payload) = classify(host)
+    # pad the payload row count to a pow2 (floor 8) so jit sees few
+    # shapes; pads point past the row range and scatter with mode="drop"
+    cap = 8
+    while cap < n_payload:
+        cap <<= 1
+    stored = (cap * t + 2 * host.shape[0] * n_tiles) * 4 + cap * 8
+    if not forced() and stored > MAX_RATIO * host.nbytes:
+        _fallback("ratio", kind)
+        return None
+    cb = CompressedBlock()
+    cb.rows, cb.words = host.shape
+    cb.tile_words, cb.n_tiles = t, n_tiles
+    cb.n_payload = n_payload
+    cb.zero_tiles, cb.run_tiles, cb.dense_tiles = zero, run, n_payload
+    cb.dense_nbytes = host.nbytes
+    cb.nbytes = stored
+    consts = const[slot < 0]
+    cb.const_uniform = bool(
+        np.all((consts == 0) | (consts == np.uint32(0xFFFFFFFF))))
+    cb.active_tiles = np.flatnonzero(
+        (slot >= 0).any(axis=0) | (const != 0).any(axis=0)).astype(np.int32)
+    cb.payload = platform.h2d_copy(_pad_rows(payload, cap))
+    cb.slot = platform.h2d_copy(slot)
+    cb.const = platform.h2d_copy(const)
+    # padded skip-index entries point one past the last row: their zero
+    # payload popcount scatters out of range and drops
+    prow = np.full(cap, host.shape[0], dtype=np.int32)
+    prow[:n_payload] = payload_row
+    ptile = np.zeros(cap, dtype=np.int32)
+    ptile[:n_payload] = payload_tile
+    cb.payload_row = platform.h2d_copy(prow)
+    cb.payload_tile = platform.h2d_copy(ptile)
+    M.REGISTRY.count(M.METRIC_COMPRESS_BLOCKS, kind=kind)
+    M.REGISTRY.count(M.METRIC_COMPRESS_DENSE_BYTES, host.nbytes)
+    M.REGISTRY.count(M.METRIC_COMPRESS_STORED_BYTES, stored)
+    M.REGISTRY.gauge(M.METRIC_COMPRESS_RATIO,
+                     host.nbytes / max(stored, 1))
+    return cb
+
+
+# ---------------------------------------------------------------------------
+# Decode (device-side gather; the oracle path and the advance target)
+# ---------------------------------------------------------------------------
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("words",))
+def _decode(payload, slot, const, words: int):
+    cap = payload.shape[0]
+    gathered = jnp.take(payload, jnp.clip(slot, 0, cap - 1), axis=0)
+    tiles = jnp.where((slot >= 0)[..., None], gathered,
+                      const[..., None].astype(payload.dtype))
+    return tiles.reshape(slot.shape[0], -1)[:, :words]
+
+
+# ---------------------------------------------------------------------------
+# Compressed per-row popcount scan (the tile-skipping fast path)
+# ---------------------------------------------------------------------------
+
+
+def _ctile_count_body(x_ref, out_ref):
+    c = jnp.sum(lax.population_count(x_ref[...]).astype(jnp.int32),
+                axis=1, keepdims=True)
+    # counts broadcast across the 128-lane minor axis; the host reads
+    # lane 0 — a full (8, 128) tile write keeps Mosaic layouts happy
+    out_ref[...] = jnp.broadcast_to(c, out_ref.shape)
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ctile_counts_pallas(x, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    t = x.shape[1]
+    out = pl.pallas_call(
+        _ctile_count_body,
+        grid=(x.shape[0] // 8,),
+        in_specs=[pl.BlockSpec((8, t), lambda g: (g, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda g: (g, 0)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 128), jnp.int32),
+        interpret=interpret,
+    )(x)
+    return out[:, 0]
+
+
+@platform.guarded_call
+@jax.jit
+def _ctile_counts_xla(x):
+    return jnp.sum(lax.population_count(x).astype(jnp.int32), axis=1)
+
+
+def _payload_counts(masked) -> jax.Array:
+    """Per-payload-entry popcounts ``int32[P]`` via the ctile_count
+    Pallas kernel (shared dispatch policy) or the jitted XLA oracle."""
+    why = PU.why_not("ctile_count", masked)
+    if why is None:
+        try:
+            with PU.kernel_scope("pop", masked.shape[0], 1, 1,
+                                 masked.shape[1]):
+                out = _ctile_counts_pallas(masked, PU.use_interpret())
+            PU.dispatched("ctile_count")
+            return out
+        except Exception as exc:  # noqa: BLE001 — strike-out policy
+            PU.failed("ctile_count", exc)
+    else:
+        PU.fallback("ctile_count", why)
+    return _ctile_counts_xla(masked)
+
+
+@platform.guarded_call
+@jax.jit
+def _mask_payload(payload, payload_tile, filt_tiles):
+    return payload & jnp.take(filt_tiles, payload_tile, axis=0)
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _scatter_counts(per_entry, payload_row, const_counts, rows: int):
+    return const_counts + jnp.zeros(
+        (rows,), jnp.int32).at[payload_row].add(per_entry, mode="drop")
+
+
+@platform.guarded_call
+@jax.jit
+def _const_counts_unfiltered(const, t: jax.Array):
+    return jnp.sum(
+        lax.population_count(const).astype(jnp.int32), axis=1) * t
+
+
+@platform.guarded_call
+@jax.jit
+def _const_counts_filtered(const, filt_tile_pop):
+    # valid only for uniform consts (0 / 0xFFFFFFFF): a zero tile
+    # contributes nothing, an all-ones run contributes the filter's own
+    # popcount over that tile
+    full = const == jnp.uint32(0xFFFFFFFF)
+    return jnp.sum(jnp.where(full, filt_tile_pop[None, :], 0), axis=1)
+
+
+def _compressed_row_counts(cb: CompressedBlock, filt) -> jax.Array:
+    if filt is not None and not cb.const_uniform:
+        # non-trivial run constants under a filter have no closed form:
+        # decode and take the classic path (rare — real runs are 0/~0)
+        from pilosa_tpu.ops import bitmap as bitops
+
+        _fallback("const", "scan")
+        return bitops.row_counts(cb.decode(), filt)
+    M.REGISTRY.count(M.METRIC_COMPRESS_TILES_SKIPPED,
+                     cb.rows * cb.n_tiles - cb.n_payload)
+    if filt is None:
+        masked = cb.payload
+        const_counts = _const_counts_unfiltered(
+            cb.const, jnp.int32(cb.tile_words))
+    else:
+        ft = _filt_tiles(filt, cb.n_tiles, cb.tile_words)
+        masked = _mask_payload(cb.payload, cb.payload_tile, ft)
+        const_counts = _const_counts_filtered(cb.const, _ctile_counts_xla(ft))
+    per_entry = _payload_counts(masked)
+    return _scatter_counts(per_entry, cb.payload_row, const_counts, cb.rows)
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("n_tiles", "t"))
+def _filt_tiles(filt, n_tiles: int, t: int):
+    pad = n_tiles * t - filt.shape[0]
+    if pad:
+        filt = jnp.pad(filt, (0, pad))
+    return filt.reshape(n_tiles, t)
+
+
+# ---------------------------------------------------------------------------
+# Compressed BSI compare: narrow to active tiles, reuse the dense engine
+# ---------------------------------------------------------------------------
+
+
+def bsi_compare_compressed(cb: CompressedBlock, op: str, value: int,
+                           value2: Optional[int] = None) -> jax.Array:
+    """Range compare over a compressed BSI plane stack: gather the
+    *active* tile columns (any plane dense or non-zero const) into a
+    narrow dense tensor, run the ordinary ``bsi_compare`` engine there,
+    and scatter the result plane back to full width.
+
+    Sound because every ``bsi_compare`` output is EXISTS-masked: a tile
+    where all planes are zero has EXISTS=0 on every column, so its
+    result words are 0 for ALL ops — exactly what the scatter leaves
+    behind. Bit-identical to ``bsi_compare(cb.decode(), ...)``.
+    """
+    from pilosa_tpu.ops import bsi as bsiops
+
+    active = cb.active_tiles
+    n_active = int(active.size)
+    if n_active == 0:
+        from pilosa_tpu.ops import bitmap as bitops
+
+        return bitops.device_zeros(cb.words)
+    M.REGISTRY.count(M.METRIC_COMPRESS_TILES_SKIPPED,
+                     cb.rows * (cb.n_tiles - n_active))
+    idx = jnp.asarray(active)
+    narrow = _decode(cb.payload, cb.slot[:, idx], cb.const[:, idx],
+                     n_active * cb.tile_words)
+    res = bsiops.bsi_compare(narrow, op, value, value2)
+    return _scatter_tiles(res, idx, cb.n_tiles, cb.tile_words, cb.words)
+
+
+@platform.guarded_call
+@functools.partial(jax.jit, static_argnames=("n_tiles", "t", "words"))
+def _scatter_tiles(res, idx, n_tiles: int, t: int, words: int):
+    full = jnp.zeros((n_tiles, t), dtype=res.dtype)
+    full = full.at[idx].set(res.reshape(-1, t))
+    return full.reshape(-1)[:words]

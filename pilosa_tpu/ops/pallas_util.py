@@ -1,8 +1,9 @@
 """Shared dispatch policy for the Pallas L0 kernel plane.
 
 Every Pallas kernel in ``ops/`` (pair counts, BSI sum/compare, TopN row
-counts, the ingest scatter, and the tape-count terminal) routes its
-go/no-go decision through :func:`why_not` so the CPU/interpret/alignment
+counts, the ingest scatter, the compressed-tile popcount ``ctile_count``,
+and the tape-count terminal) routes its go/no-go decision through
+:func:`why_not` so the CPU/interpret/alignment
 rules cannot drift per-file, and records the outcome on the metrics
 registry so silent degradation to the classic XLA path is visible on the
 timeline:
@@ -154,7 +155,8 @@ def reset_failures() -> None:
 def kernel_scope(op: str, d1: int, d2: int, n_inputs: int,
                  total_words: int):
     """devprof attribution scope for one Pallas dispatch. ``op`` is the
-    pallas cost family (``mm`` | ``cmp`` | ``scatter``), ``d1``/``d2``
+    pallas cost family (``mm`` | ``cmp`` | ``scatter`` | ``pop``),
+    ``d1``/``d2``
     its two dimension parameters (see devprof.tape_cost). No-op scope
     when profiling is off."""
     from pilosa_tpu.obs import devprof

@@ -706,16 +706,17 @@ class Executor:
         if not isinstance(value, Condition):
             value = Condition("==", value)
         op = _COND_TO_BSI[value.op]
+        # st.compare narrows compressed-resident stacks to active tiles
+        # (ops/ctiles.py); dense stacks take the classic bsi_compare
         if value.op == "between":
             lo, hi = value.value
-            return S.bsi_compare(st.planes, op,
-                                 field.to_stored(lo), field.to_stored(hi))
+            return st.compare(op, field.to_stored(lo), field.to_stored(hi))
         if value.value is None:
             # `!= null` = exists; `== null` = not exists (needs existence).
             if value.op == "!=":
                 return st.exists_plane()
             raise PQLError("== null is not supported; use Not(Row(f != null))")
-        return S.bsi_compare(st.planes, op, field.to_stored(value.value))
+        return st.compare(op, field.to_stored(value.value))
 
     # -- top-level materialization --------------------------------------------
 

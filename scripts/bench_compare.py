@@ -158,6 +158,12 @@ def _selftest(threshold: float) -> int:
         "c20_pallas_parity (cpu)":
             {"metric": "c20_pallas_parity (cpu)", "value": 6.0,
              "unit": "families", "vs_baseline": 1.0},
+        # the compressed-residency gate emits resident-rows-per-budget as
+        # a ratio: a DROP means blocks stopped compressing (or the
+        # format bloated) and must gate like a throughput metric
+        "c21_compress_resident_rows (cpu)":
+            {"metric": "c21_compress_resident_rows (cpu)", "value": 15.0,
+             "unit": "x", "vs_baseline": 15.0},
     }
     same = compare(base, base, threshold)
     assert same and not any(r["regressed"] for r in same), \
@@ -168,11 +174,13 @@ def _selftest(threshold: float) -> int:
     slow["c1_ingest (cpu)"]["value"] = 400000.0           # rows/s down 20%
     slow["c19_dax_fresh_node_read_p99 (cpu)"]["value"] = 48.0  # ms up 20%
     slow["c20_pallas_parity (cpu)"]["value"] = 4.0    # families down 33%
+    slow["c21_compress_resident_rows (cpu)"]["value"] = 10.0  # x down 33%
     rows = compare(base, slow, threshold)
     bad = {r["metric"] for r in rows if r["regressed"]}
     assert bad == {"c13_resident_warm_p50", "c1_ingest",
                    "c19_dax_fresh_node_read_p99",
-                   "c20_pallas_parity"}, bad
+                   "c20_pallas_parity",
+                   "c21_compress_resident_rows"}, bad
     # a 10% drift stays under the default 15% gate
     drift = {k: dict(v) for k, v in base.items()}
     drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0

@@ -258,6 +258,36 @@ echo "== pallas parity/speedup bench gate (bench.py --configs 20) =="
 # >= 1.3x p50 speedup (CPU runs time it unenforced under interpret).
 JAX_PLATFORMS=cpu python bench.py --configs 20 || exit $?
 
+echo "== compressed-residency lane (PILOSA_TPU_COMPRESS=1 + PALLAS=1) =="
+# Every stacked read path (point reads, TopN/row_counts streaming,
+# GroupBy, BSI compare, the paging/eviction/advance protocols) consumes
+# compressed-resident blocks, with the ctile_count Pallas kernel forced
+# through the interpreter: results must stay bit-identical to the dense
+# suites above. Forced mode overrides the size/ratio/mesh policy so the
+# virtual 8-device test mesh exercises the compressed format too.
+PILOSA_TPU_COMPRESS=1 PILOSA_TPU_PALLAS=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_compress.py tests/test_paging.py \
+    tests/test_resident.py tests/test_pallas_parity.py \
+    tests/test_stacked_merge.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== compress kill-switch lane (PILOSA_TPU_COMPRESS=0) =="
+# The same stacked/paging suites with compression disabled: every block
+# stays a dense jax.Array and test_compress's kill-switch tests assert
+# zero compress-metric movement (the switch must cost nothing).
+PILOSA_TPU_COMPRESS=0 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_compress.py tests/test_paging.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
+echo "== compressed residency bench gate (bench.py --configs 21) =="
+# Hard-asserts the ISSUE 18 acceptance bar in-process: kill switch ->
+# dense blocks, zero compress-metric/kernel movement; forced -> decode,
+# plain+filtered row_counts and BSI compare bit-identical to the dense
+# oracle AND >= 10x resident rows under the same DeviceBudget byte cap;
+# on TPU backends the tile-skipping scan additionally hard-asserts p50
+# no worse than the dense scan on sparse rows.
+JAX_PLATFORMS=cpu python bench.py --configs 21 || exit $?
+
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver
 # wrappers when present. CI gates fatally against a pinned baseline.

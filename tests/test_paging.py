@@ -164,6 +164,76 @@ def test_write_qcx_stack_releases_budget(paged_env):
         "write-qcx stack leaked budget entries")
 
 
+def test_executor_retries_stack_stale_midstream(paged_env, monkeypatch):
+    """A writer landing AFTER the executor fetched its stack snapshot but
+    BEFORE a lazy block build must surface as StackStale and be retried
+    transparently — the full-scan result includes the racing write."""
+    h, e, f, oracle = paged_env
+    retries0 = stx.PAGING_STATS["stale_retries"]
+    orig = stx.StackedSet._ensure_block
+    state = {"armed": True}
+
+    def racing_write(self, bi):
+        if state["armed"] and bi > 0 and self._blocks[bi] is None:
+            state["armed"] = False
+            f.fragment(0).set_bit(0, 123)  # the concurrent writer
+        return orig(self, bi)
+
+    monkeypatch.setattr(stx.StackedSet, "_ensure_block", racing_write)
+    top = e.execute("i", f"TopN(f, n={ROWS})")[0]
+    assert not state["armed"], "the race never fired"
+    assert stx.PAGING_STATS["stale_retries"] > retries0, \
+        "the mid-stream write did not trip the StackStale protocol"
+    oracle.setdefault(0, set()).add(123)
+    got = {p.id: p.count for p in top.pairs}
+    assert got == {r: len(cs) for r, cs in oracle.items()}
+
+
+def test_eviction_racing_iter_blocks_reader(paged_env):
+    """A budget evictor hammering _drop_block concurrently with an
+    iter_blocks()/row_counts() reader: every pass rebuilds transparently
+    and stays bit-identical (no writes, so never StackStale)."""
+    import threading
+
+    from pilosa_tpu.core.stacked import stacked_set
+    from pilosa_tpu.ops import bitmap as B
+
+    h, e, f, oracle = paged_env
+    st = stacked_set(f, [0, 1], "standard")
+    assert st.paged and st.n_blocks > 2
+    want = np.zeros(len(st.row_ids), dtype=np.int64)
+    for r, cs in oracle.items():
+        want[st.row_index[r]] = len(cs)
+    retries0 = stx.PAGING_STATS["stale_retries"]
+    builds0 = stx.PAGING_STATS["block_builds"]
+    stop = threading.Event()
+
+    def evictor():
+        erng = np.random.default_rng(11)
+        while not stop.is_set():
+            bi = int(erng.integers(0, st.n_blocks))
+            st._drop_block(bi)
+            stx.BUDGET.release((st.serial, bi))
+
+    t = threading.Thread(target=evictor)
+    t.start()
+    try:
+        for _ in range(3):
+            got = np.asarray(st.row_counts()).astype(np.int64)
+            assert np.array_equal(got, want)
+        total = 0
+        for _, blk in st.iter_blocks():
+            total += int(np.asarray(B.row_counts(blk)).sum())
+        assert total == int(want.sum())
+    finally:
+        stop.set()
+        t.join()
+    assert stx.PAGING_STATS["stale_retries"] == retries0, \
+        "eviction (not staleness) was under test — no writes happened"
+    assert stx.PAGING_STATS["block_builds"] > builds0, \
+        "the evictor never forced a rebuild"
+
+
 def test_advance_under_tiny_budget_no_crash(monkeypatch):
     """_advance_set must assign _blocks before charging: an eviction
     cascade can pop the new stack's own earlier entries."""
