@@ -69,6 +69,11 @@ class API:
         # usage accounting, quotas, fair-share weights. None = off and
         # the request paths pay one attribute check.
         self.tenants = None
+        # optional graceful-degradation ladder (sched/degrade.py):
+        # NORMAL -> SHED_BATCH -> BROWNOUT -> SATURATED driven by
+        # timeline signals. None = off; scheduler/cache pay one
+        # attribute check and no degrade metric ever moves.
+        self.degrade = None
         if path:
             # checkpoint load + WAL replay (reference: rbf/db.go open)
             self.holder.recover()
@@ -86,6 +91,10 @@ class API:
             # attribution-only defaults (quotas 0 = unlimited): safe to
             # run the whole suite under, like the timeline env gate
             self.enable_tenants()
+        if env_bool("PILOSA_TPU_DEGRADE"):
+            # ladder only engages past its thresholds, so always-on is
+            # safe; without the health plane it simply never ticks
+            self.enable_degrade()
 
     def set_query_logger(self, path: str) -> None:
         from pilosa_tpu.obs.logger import QueryLogger
@@ -111,6 +120,7 @@ class API:
         else:
             self.scheduler = QueryScheduler(self.executor, **overrides)
         self._wire_tenants()
+        self._wire_degrade()
         return self.scheduler
 
     def disable_scheduler(self) -> None:
@@ -141,6 +151,7 @@ class API:
         self.cache = ResultCache.from_config(config, **overrides)
         self.executor.cache = self.cache
         self._wire_tenants()
+        self._wire_degrade()
         return self.cache
 
     def disable_cache(self) -> None:
@@ -170,6 +181,7 @@ class API:
             self._health_set_exemplars = True
         if start:
             self.health.start()
+        self._wire_degrade()
         return self.health
 
     def disable_health(self) -> None:
@@ -260,6 +272,51 @@ class API:
             self.cache.tenant_quota_bytes = 0
         if self.scheduler is not None:
             self.scheduler.set_fair_share(False)
+
+    # -- graceful degradation (sched/degrade.py: brownout ladder) ----------
+
+    def enable_degrade(self, config=None, **overrides):
+        """Attach the graceful-degradation controller: a hysteresis-
+        bounded NORMAL -> SHED_BATCH -> BROWNOUT -> SATURATED ladder fed
+        by the health timeline (queue depth, SLO fast-burn, deadline-miss
+        and device-budget-eviction rates). SHED_BATCH rejects batch
+        admissions first; BROWNOUT lets the result cache serve entries
+        past their version fingerprint (tagged stale=true) and tightens
+        deadlines; SATURATED sheds interactive work with an honest
+        Retry-After from the live arrival window. ``config`` is a
+        pilosa_tpu.config.Config ([degrade]); kwargs override
+        DegradeController knobs. Signals only flow while a health plane
+        is attached (enable order doesn't matter)."""
+        from pilosa_tpu.sched.degrade import DegradeController
+
+        self.degrade = DegradeController.from_config(config, **overrides)
+        self._wire_degrade()
+        return self.degrade
+
+    def _wire_degrade(self) -> None:
+        """Point whichever planes exist right now at the controller;
+        enable_scheduler/enable_cache/enable_health call this again so
+        enable order doesn't matter. The timeline observer and probe
+        read through ``api.degrade`` at sample time, so a later
+        enable_degrade is picked up without re-wiring."""
+        deg = self.degrade
+        if deg is None:
+            return
+        if self.scheduler is not None:
+            self.scheduler.degrade = deg
+            deg.retry_after_fn = self.scheduler.retry_after_s
+        if self.cache is not None:
+            self.cache.degrade = deg
+        deg.flight = self.health.flight if self.health is not None else None
+
+    def disable_degrade(self) -> None:
+        deg, self.degrade = self.degrade, None
+        if deg is None:
+            return
+        if self.scheduler is not None:
+            self.scheduler.degrade = None
+        if self.cache is not None:
+            self.cache.degrade = None
 
     # -- schema (reference: api.go CreateIndex/CreateField/Schema) ---------
 
@@ -475,11 +532,30 @@ class API:
                                       deadline_ms=deadline_ms)
             out["profile"] = root.to_json()
             return out
+        cache = self.cache
+        if cache is not None:
+            cache.take_stale_flag()  # clear any untagged leftover
         results = [result_to_json(r) for r in self.query(
             index, pql, priority=priority, deadline_ms=deadline_ms)]
-        return {"results": results}
+        out = {"results": results}
+        if cache is not None and cache.take_stale_flag():
+            # brownout: served past the version fingerprint — the
+            # explicit freshness contract for degraded reads
+            out["stale"] = True
+        return out
 
     # -- bulk import (reference: api.go:1438 Import / ImportValue) ---------
+
+    def _degrade_shed_batch(self) -> None:
+        """Bulk-import ingress is batch-priority work: at SHED_BATCH and
+        above the HTTP import surface refuses the whole request up front
+        with an honest Retry-After (the client retries an idempotent
+        request later). The check lives at ingress — not inside
+        import_bits — so SQL DML, WAL replay, recovery catch-up, and
+        replica fan-out legs can never be torn mid-statement by a shed."""
+        deg = self.degrade
+        if deg is not None and deg.shed_reason("batch") is not None:
+            raise deg.shed("batch")
 
     def import_bits(self, index: str, field: str,
                     rows: Sequence[int] = (),

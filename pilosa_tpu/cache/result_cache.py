@@ -85,6 +85,7 @@ class _Entry:
     cost: int
     expires_at: float  # monotonic deadline; inf = no TTL
     tenant: Optional[str] = None  # inserting tenant (resident quota)
+    inserted_at: float = 0.0  # monotonic insert time (stale-age bound)
 
 
 class ResultCache:
@@ -121,6 +122,17 @@ class ResultCache:
         self.tenant_of = None
         self.tenant_quota_bytes = 0
         self._tenant_bytes: Dict[str, int] = {}
+        # brownout stale serving (sched/degrade.py, wired by
+        # API.enable_degrade): the version fingerprint is the LAST key
+        # element, so ``key[:-1]`` names "this query on these shards at
+        # any version" and _stale_last maps it to the newest resident
+        # full key. During BROWNOUT a miss may fall back to that entry —
+        # age-bounded, counted, and flagged on a thread-local so the
+        # response layer tags it stale=true. None costs nothing.
+        self.degrade = None
+        self._stale_last: Dict[Tuple, Tuple] = {}
+        self._stale_serves = 0
+        self._tls = threading.local()
 
     @classmethod
     def from_config(cls, config=None, **overrides) -> "ResultCache":
@@ -134,15 +146,32 @@ class ResultCache:
 
     # -- primitives --------------------------------------------------------
 
-    def lookup(self, key: Tuple, count_miss: bool = True
-               ) -> Tuple[bool, Any]:
+    def lookup(self, key: Tuple, count_miss: bool = True,
+               allow_stale: bool = True) -> Tuple[bool, Any]:
         """(hit, value). Counts hit/miss and observes hit latency.
         ``count_miss=False`` makes a miss silent — for peek-style call
         sites (scheduler admission) whose misses fall through to a
-        second, authoritative lookup at dispatch."""
+        second, authoritative lookup at dispatch. ``allow_stale=False``
+        disables the brownout stale path: remote-serving legs pass it so
+        a partial served over the internal RPC is never silently stale —
+        only the client-facing node stale-serves, and it tags the
+        response."""
         t0 = time.perf_counter()
+        stale = False
         with self._lock:
             value, hit = self._get_locked(key)
+            if not hit and allow_stale:
+                deg = self.degrade
+                if deg is not None and deg.brownout_active():
+                    value, hit, stale = self._get_stale_locked(
+                        key, deg.stale_ttl_s)
+        if stale:
+            self._stale_serves += 1
+            self.registry.count(M.METRIC_CACHE_STALE_SERVES)
+            self._tls.stale = True
+            active_span().record("cache.lookup", time.perf_counter() - t0,
+                                 outcome="stale")
+            return True, value
         if hit:
             self._hits += 1
             self.registry.count(M.METRIC_CACHE_HITS)
@@ -223,7 +252,8 @@ class ResultCache:
         if cost > self.max_bytes:
             return  # would evict the whole cache for one entry
         tenant = self.tenant_of() if self.tenant_of is not None else None
-        expires = (self.clock() + self.ttl_ms / 1000.0
+        now = self.clock()
+        expires = (now + self.ttl_ms / 1000.0
                    if self.ttl_ms > 0 else float("inf"))
         stored = copy.deepcopy(value)
         with self._lock:
@@ -240,8 +270,11 @@ class ResultCache:
             if old is not None:
                 self._bytes -= old.cost
                 self._tenant_credit_locked(old)
-            self._entries[key] = _Entry(stored, cost, expires, tenant)
+            self._entries[key] = _Entry(stored, cost, expires, tenant,
+                                        inserted_at=now)
             self._bytes += cost
+            if isinstance(key, tuple) and len(key) >= 2:
+                self._stale_last[key[:-1]] = key
             if tenant is not None:
                 self._tenant_bytes[tenant] = \
                     self._tenant_bytes.get(tenant, 0) + cost
@@ -253,12 +286,21 @@ class ResultCache:
         if self.tenant_hook is not None:
             self.tenant_hook("bytes", cost)
 
-    def run(self, key: Tuple, compute: Callable[[], Any]) -> Any:
+    def run(self, key: Tuple, compute: Callable[[], Any],
+            allow_stale: bool = True) -> Any:
         """Hit → cached copy. Miss as leader → compute (timed into the
         dispatch-latency histogram), publish, return the *original*
         object (the caller may keep mutating it; the cache holds a deep
         copy). Miss as follower → wait for the leader and return a copy.
         """
+        deg = self.degrade
+        if allow_stale and deg is not None and deg.brownout_active():
+            # brownout: prefer any fresh-or-stale resident answer over
+            # computing (the stale path flags the thread-local so the
+            # caller's response layer can tag it)
+            hit, value = self.lookup(key, count_miss=False)
+            if hit:
+                return value
         state, payload = self.fetch(key)
         if state == "hit":
             return payload
@@ -282,6 +324,13 @@ class ResultCache:
         """An uncacheable request passed through (key was None)."""
         self.registry.count(M.METRIC_CACHE_BYPASS)
 
+    def mark_stale(self) -> None:
+        """Raise the brownout stale flag on the CURRENT thread. The
+        cluster fan-out runs remote-leg cache wrappers on pool threads;
+        it pops their flags there and forwards with this, so the request
+        thread's response layer still sees one honest signal."""
+        self._tls.stale = True
+
     def observe_dispatch(self, seconds: float) -> None:
         """Compute time behind a miss — contrast with the hit
         histogram to read the amortization win off /metrics."""
@@ -295,6 +344,7 @@ class ResultCache:
             self._entries.clear()
             self._bytes = 0
             self._tenant_bytes.clear()
+            self._stale_last.clear()
             self._update_gauges_locked()
         if n:
             self._evictions += n
@@ -313,7 +363,17 @@ class ResultCache:
                 "misses": self._misses,
                 "evictions": self._evictions,
                 "inflight": len(self._inflight),
+                "stale_serves": self._stale_serves,
             }
+
+    def take_stale_flag(self) -> bool:
+        """Pop this thread's served-stale marker (set when a brownout
+        lookup fell back past the version fingerprint). The response
+        layer calls this once per request to tag stale=true; calling it
+        before the lookup clears any leftover from an untagged path."""
+        was = getattr(self._tls, "stale", False)
+        self._tls.stale = False
+        return was
 
     def hit_ratio(self) -> float:
         """Lifetime hits / (hits + misses), 0.0 before any lookup (the
@@ -336,6 +396,7 @@ class ResultCache:
             del self._entries[key]
             self._bytes -= e.cost
             self._tenant_credit_locked(e)
+            self._drop_stale_ref_locked(key)
             self._evictions += 1
             self.registry.count(M.METRIC_CACHE_EVICTIONS, reason="ttl")
             self._update_gauges_locked()
@@ -344,11 +405,42 @@ class ResultCache:
         return copy.deepcopy(e.value), True
 
     def _evict_locked(self, reason: str) -> None:
-        _, e = self._entries.popitem(last=False)
+        key, e = self._entries.popitem(last=False)
         self._bytes -= e.cost
         self._tenant_credit_locked(e)
+        self._drop_stale_ref_locked(key)
         self._evictions += 1
         self.registry.count(M.METRIC_CACHE_EVICTIONS, reason=reason)
+
+    def _drop_stale_ref_locked(self, key: Tuple) -> None:
+        """An entry left the cache: if the stale index pointed at it,
+        drop the pointer (keeps _stale_last <= live-entry count)."""
+        if isinstance(key, tuple) and len(key) >= 2 \
+                and self._stale_last.get(key[:-1]) == key:
+            del self._stale_last[key[:-1]]
+
+    def _get_stale_locked(self, key: Tuple, max_age_s: float
+                          ) -> Tuple[Any, bool, bool]:
+        """Brownout fallback: the newest resident entry for this query
+        at ANY version fingerprint (``key[:-1]``), provided it is
+        younger than ``max_age_s`` and not TTL-expired. Returns
+        (value, hit, stale)."""
+        if not isinstance(key, tuple) or len(key) < 2:
+            return None, False, False
+        full = self._stale_last.get(key[:-1])
+        if full is None or full == key:
+            return None, False, False
+        e = self._entries.get(full)
+        if e is None:  # pointer outlived a flush/eviction race
+            self._stale_last.pop(key[:-1], None)
+            return None, False, False
+        now = self.clock()
+        if e.expires_at <= now:
+            return None, False, False  # TTL reaper owns the delete
+        if max_age_s > 0 and now - e.inserted_at > max_age_s:
+            return None, False, False
+        self._entries.move_to_end(full)
+        return copy.deepcopy(e.value), True, True
 
     def _tenant_credit_locked(self, e: _Entry) -> None:
         if e.tenant is None:

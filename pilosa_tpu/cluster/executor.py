@@ -246,6 +246,10 @@ class ClusterExecutor:
                                        token=token)[0])
 
         cache = self.cache
+        # brownout stale serves happen on fan-out pool threads; the leg
+        # wrappers pop their thread's flag into this request-scoped box
+        # and it is re-raised on the request thread after the fan
+        leg_stale = [False]
         if cache is not None and self.gossip is not None:
             from pilosa_tpu.cache.keys import shard_key
             gossip = self.gossip
@@ -257,7 +261,10 @@ class ClusterExecutor:
                 # key and the stale entry simply never matches again
                 key = ("rlegg", idx.name, pql, shard_key(s),
                        gossip.remote_fingerprint(idx.name, s))
-                return cache.run(key, lambda: _raw(node, s, token))
+                out = cache.run(key, lambda: _raw(node, s, token))
+                if cache.take_stale_flag():
+                    leg_stale[0] = True
+                return out
 
             run_remote = run_remote_gossip
         elif cache is not None and cache.ttl_ms > 0:
@@ -268,13 +275,19 @@ class ClusterExecutor:
                 # some of these shards still hits on the shared legs
                 key = ("rleg", idx.name, pql, shard_key(s),
                        self._write_epoch.get(idx.name, 0))
-                return cache.run(key, lambda: _raw(node, s, token))
+                out = cache.run(key, lambda: _raw(node, s, token))
+                if cache.take_stale_flag():
+                    leg_stale[0] = True
+                return out
 
             run_remote = run_remote_cached
-        return self._fan_shards(
+        out = self._fan_shards(
             idx.name, shards,
             lambda s: self._run_local_read(idx.name, call, s),
             run_remote, hedgeable=call.name not in _WRITE_CALLS)
+        if leg_stale[0] and cache is not None:
+            cache.mark_stale()
+        return out
 
     def _run_local_read(self, index: str, call: Call,
                         shards: Sequence[int]) -> Any:

@@ -93,6 +93,12 @@ class LocalCluster:
         node order."""
         return [node.enable_tenants(**kw) for node in self.nodes]
 
+    def enable_degrade(self, **kw) -> list:
+        """Enable the graceful-degradation ladder on every node
+        (ClusterNode.enable_degrade kwargs pass through). Returns the
+        controllers in node order."""
+        return [node.enable_degrade(**kw) for node in self.nodes]
+
     def enable_membership(self, **kw) -> list:
         """Enable SWIM membership on every node (ClusterNode.enable_
         membership kwargs pass through; gossip auto-enables). Tests

@@ -303,8 +303,18 @@ class ClusterNode:
                                       deadline_ms=deadline_ms)
             out["profile"] = root.to_json()
             return out
-        return {"results": [result_to_json(r) for r in self.query(
+        cache = self.cache
+        if cache is not None:
+            cache.take_stale_flag()  # clear any untagged leftover
+        out = {"results": [result_to_json(r) for r in self.query(
             index, pql, priority=priority, deadline_ms=deadline_ms)]}
+        if cache is not None and cache.take_stale_flag():
+            # brownout: a fan-out leg was served past its version
+            # fingerprint — the explicit freshness contract for
+            # degraded reads (executor.cache and executor.local.cache
+            # are the same object, so one flag covers both legs)
+            out["stale"] = True
+        return out
 
     # -- scheduler (sched/): same surface as the plain API -----------------
 
@@ -325,6 +335,7 @@ class ClusterNode:
             sched = QueryScheduler(self.executor.local, **overrides)
         self.executor.scheduler = sched
         self._wire_node_tenants()
+        self._wire_node_degrade()
         return sched
 
     def disable_scheduler(self) -> None:
@@ -353,6 +364,7 @@ class ClusterNode:
         self.executor.cache = cache
         self.executor.local.cache = cache
         self._wire_node_tenants()
+        self._wire_node_degrade()
         return cache
 
     def disable_cache(self) -> None:
@@ -404,6 +416,43 @@ class ClusterNode:
         if sched is not None and getattr(self.api, "_tenants_fair", True):
             sched.set_fair_share(True, reg.weight)
 
+    # -- graceful degradation (sched/degrade.py): node-side wiring ---------
+
+    @property
+    def degrade(self):
+        return self.api.degrade
+
+    def enable_degrade(self, config=None, **overrides):
+        """Attach the brownout ladder (see API.enable_degrade) and wire
+        it into the node's cluster-side scheduler/cache — which hang off
+        ClusterExecutor, not the base API."""
+        deg = self.api.enable_degrade(config, **overrides)
+        self._wire_node_degrade()
+        return deg
+
+    def disable_degrade(self) -> None:
+        self.api.disable_degrade()
+        if self.executor.scheduler is not None:
+            self.executor.scheduler.degrade = None
+        for cache in (self.executor.cache, self.executor.local.cache):
+            if cache is not None:
+                cache.degrade = None
+
+    def _wire_node_degrade(self) -> None:
+        """Mirror of _wire_node_tenants: point whichever node-level
+        planes exist at the controller; enable_cache/enable_scheduler/
+        enable_health call this again so enable order doesn't matter."""
+        deg = self.api.degrade
+        if deg is None:
+            return
+        sched = self.executor.scheduler
+        if sched is not None:
+            sched.degrade = deg
+            deg.retry_after_fn = sched.retry_after_s
+        for cache in (self.executor.cache, self.executor.local.cache):
+            if cache is not None:
+                cache.degrade = deg
+
     # -- fan-out resilience (cluster/resilience.py) ------------------------
 
     @property
@@ -452,6 +501,7 @@ class ClusterNode:
         plane = self.api.enable_health(config, start=start, **overrides)
         plane.attach_node(self)
         self._wire_health_resilience()
+        self._wire_node_degrade()
         return plane
 
     def disable_health(self) -> None:
@@ -859,6 +909,7 @@ class ClusterNode:
     # this node's import methods (shard owners + replicas). Same
     # lazy-init as the single-node path — share the one implementation.
     sql = API.sql
+    _degrade_shed_batch = API._degrade_shed_batch
     _maybe_slow_log = API._maybe_slow_log
 
     @property
@@ -894,7 +945,7 @@ class ClusterNode:
                         remote=True)):
                 return 0  # queued: applies after catch-up completes
             n = self.api.import_bits(index, field, rows=rows, cols=cols,
-                                     clear=clear)
+                                     clear=clear, remote=True)
             self._announce_shards(index)
             return n
         self._check_state(write=True)
@@ -912,7 +963,8 @@ class ClusterNode:
                        "cols": shard_cols, "clear": clear, "remote": True}
             if node.id == self.node.id:
                 n = self.api.import_bits(index, field, rows=shard_rows,
-                                         cols=shard_cols, clear=clear)
+                                         cols=shard_cols, clear=clear,
+                                         remote=True)
             else:
                 n = self.client.import_bits(node, index, field,
                                             payload).get("changed", 0)
@@ -930,7 +982,8 @@ class ClusterNode:
                         index, field, cols=cols, values=values,
                         remote=True)):
                 return 0
-            n = self.api.import_values(index, field, cols=cols, values=values)
+            n = self.api.import_values(index, field, cols=cols,
+                                       values=values, remote=True)
             self._announce_shards(index)
             return n
         self._check_state(write=True)
@@ -945,7 +998,7 @@ class ClusterNode:
                        "values": shard_vals, "remote": True}
             if node.id == self.node.id:
                 n = self.api.import_values(index, field, cols=shard_cols,
-                                           values=shard_vals)
+                                           values=shard_vals, remote=True)
             else:
                 n = self.client.import_values(node, index, field,
                                               payload).get("imported", 0)

@@ -266,6 +266,31 @@ class Config:
     dax_autoscale_queue_high: int = 16  # queue depth scale-up trigger
     dax_autoscale_p99_high_ms: float = 250.0  # leg p99 scale-up trigger
 
+    # graceful-degradation ladder ([degrade] section / PILOSA_TPU_DEGRADE_*):
+    # NORMAL -> SHED_BATCH -> BROWNOUT -> SATURATED state machine driven
+    # by timeline signals (sched/degrade.py; attach via API.enable_degrade
+    # or PILOSA_TPU_DEGRADE=1). Thresholds are the ENTER edges; exit edges
+    # are enter * degrade_exit_ratio, and a level change additionally needs
+    # degrade_up_hold / degrade_down_hold consecutive samples past the edge
+    # plus degrade_min_dwell_s since the last transition (hysteresis).
+    degrade_enabled: bool = False
+    degrade_queue_shed: float = 0.50  # queue fraction -> SHED_BATCH
+    degrade_queue_brownout: float = 0.75  # queue fraction -> BROWNOUT
+    degrade_queue_saturate: float = 0.92  # queue fraction -> SATURATED
+    degrade_burn_shed: float = 2.0  # SLO fast-burn -> SHED_BATCH
+    degrade_burn_brownout: float = 6.0  # SLO fast-burn -> BROWNOUT
+    degrade_burn_saturate: float = 14.0  # SLO fast-burn -> SATURATED
+    degrade_miss_rate_brownout: float = 1.0  # deadline misses/s -> BROWNOUT
+    degrade_eviction_rate_shed: float = 50.0  # budget evictions/s -> SHED
+    degrade_exit_ratio: float = 0.7  # exit edge = enter edge * ratio
+    degrade_up_hold: int = 1  # consecutive hot samples to escalate
+    degrade_down_hold: int = 3  # consecutive cool samples to step down
+    degrade_min_dwell_s: float = 1.0  # floor between transitions
+    degrade_deadline_factor: float = 0.5  # brownout deadline multiplier
+    degrade_brownout_deadline_ms: float = 250.0  # imposed when none set
+    degrade_stale_ttl_ms: float = 30000.0  # max age of a brownout stale read
+    degrade_retry_after_s: float = 1.0  # saturated-shed fallback hint
+
     # -- sources -----------------------------------------------------------
 
     @classmethod

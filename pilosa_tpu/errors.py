@@ -11,9 +11,17 @@ class ClusterStateError(RuntimeError):
 
 
 class AdmissionError(RuntimeError):
-    """Query rejected at admission: the scheduler queue is full (or the
-    scheduler is closed). Maps to HTTP 429 — shed load under overload
-    instead of queueing unboundedly."""
+    """Query rejected at admission: the scheduler queue is full, the
+    scheduler is closed, or the degradation ladder is shedding. Maps to
+    HTTP 429 — shed load under overload instead of queueing unboundedly.
+    ``retry_after_s``, when set, is surfaced as a Retry-After header;
+    scheduler sheds derive it from the live adaptive arrival window so
+    clients back off for roughly one queue-drain instead of blind."""
+
+    def __init__(self, message: str = "", retry_after_s=None):
+        super().__init__(message)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
 
 
 class QueryDeadlineError(RuntimeError):

@@ -79,6 +79,13 @@ def _tenants_probe(owner):
     return reg.timeline_probe()
 
 
+def _degrade_probe(owner):
+    deg = getattr(owner, "degrade", None)
+    if deg is None:
+        return {"enabled": False}
+    return deg.probe()
+
+
 class HealthPlane:
     """Timeline sampler + SLO tracker + flight recorder, wired."""
 
@@ -173,6 +180,14 @@ class HealthPlane:
         # per-tenant top-K rates ride the samples too, so flight bundles
         # capture WHICH tenant was burning during an anomaly
         self.timeline.add_probe("tenants", lambda: _tenants_probe(api))
+        # graceful-degradation ladder (sched/degrade.py): both reads go
+        # through api.degrade at sample time, so enable_degrade before
+        # or after enable_health both wire up. The observer closes the
+        # control loop — every timeline sample ticks the state machine.
+        self.timeline.add_probe("degrade", lambda: _degrade_probe(api))
+        self.timeline.add_observer(
+            lambda sample: (api.degrade.observe(sample)
+                            if api.degrade is not None else None))
 
     def attach_dax(self, queryer=None, controller=None,
                    autoscaler=None) -> None:

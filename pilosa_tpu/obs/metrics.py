@@ -196,6 +196,17 @@ METRIC_COMPRESS_TILES_SKIPPED = "device_compress_tiles_skipped_total"
 METRIC_TIMELINE_SAMPLES = "timeline_samples_total"
 METRIC_SLO_BURN_RATE = "slo_burn_rate"
 METRIC_FLIGHT_BUNDLES = "flight_bundles_total"
+# graceful-degradation control plane (sched/degrade.py): current ladder
+# level as a gauge (0=normal 1=shed_batch 2=brownout 3=saturated),
+# hysteresis-bounded transitions (labelled from=/to=/reason=), work shed
+# by the ladder (labelled priority=/level= — rides on top of the
+# per-reason sched_rejected_total series), and result-cache entries
+# served past their version fingerprint during brownout (every one is
+# tagged stale=true on the response). PILOSA_TPU_DEGRADE=0 ticks none.
+METRIC_DEGRADE_STATE = "degrade_state"
+METRIC_DEGRADE_TRANSITIONS = "degrade_transitions_total"
+METRIC_DEGRADE_SHED = "degrade_shed_total"
+METRIC_CACHE_STALE_SERVES = "cache_stale_serves_total"
 # kernel performance attribution plane (obs/devprof.py): the analytic
 # FLOP/byte cost model over the compiled op tapes. Counters accumulate
 # per-family dispatches / device seconds / bit-op FLOPs / HBM bytes

@@ -240,7 +240,8 @@ class Executor:
                 cache.bypass()
             else:
                 return cache.run(
-                    key, lambda: self._execute_read(idx, query, shards))
+                    key, lambda: self._execute_read(idx, query, shards),
+                    allow_stale=not self.remote)
         return self._execute_read(idx, query, shards)
 
     def cache_key(self, index, query,

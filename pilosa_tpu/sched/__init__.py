@@ -15,6 +15,7 @@ Layout:
     scheduler.py  admission queue, priorities, deadlines, worker loop
     batch.py      shape keys + fused batch execution / result scatter
     clock.py      injectable time sources (deterministic tests)
+    degrade.py    graceful-degradation (brownout) ladder
 """
 
 from pilosa_tpu.sched.batch import GroupKey, execute_batch, group_key
@@ -22,14 +23,19 @@ from pilosa_tpu.sched.clock import ManualClock, MonotonicClock
 from pilosa_tpu.sched.deadline import (
     Deadline, current_deadline, deadline_scope, remaining_budget_s,
 )
+from pilosa_tpu.sched.degrade import (
+    BROWNOUT, NORMAL, SATURATED, SHED_BATCH, DegradeController,
+)
 from pilosa_tpu.sched.scheduler import (
     PRIORITY_BATCH, PRIORITY_INTERACTIVE, QueryScheduler, ScheduledQuery,
     SchedulingExecutor,
 )
 
 __all__ = [
-    "Deadline", "GroupKey", "ManualClock", "MonotonicClock",
-    "PRIORITY_BATCH", "PRIORITY_INTERACTIVE", "QueryScheduler",
-    "ScheduledQuery", "SchedulingExecutor", "current_deadline",
-    "deadline_scope", "execute_batch", "group_key", "remaining_budget_s",
+    "BROWNOUT", "Deadline", "DegradeController", "GroupKey",
+    "ManualClock", "MonotonicClock", "NORMAL", "PRIORITY_BATCH",
+    "PRIORITY_INTERACTIVE", "QueryScheduler", "SATURATED",
+    "ScheduledQuery", "SchedulingExecutor", "SHED_BATCH",
+    "current_deadline", "deadline_scope", "execute_batch", "group_key",
+    "remaining_budget_s",
 ]

@@ -178,6 +178,10 @@ class QueryScheduler:
         # Toggled live by API.enable_tenants (order-independent wiring).
         self.fair_share = bool(fair_share)
         self.tenant_weight = None  # callable tenant -> weight, else 1.0
+        # graceful-degradation ladder (sched/degrade.py), wired by
+        # API.enable_degrade; None (the default) costs one attribute
+        # read per admission and ticks nothing
+        self.degrade = None
         self._tenant_vtime = {}
         self._vclock = 0.0
         self._worker = threading.Thread(
@@ -225,10 +229,25 @@ class QueryScheduler:
             deadline_s = self.default_deadline_s
         else:
             deadline_s = max(0.0, float(deadline_ms)) / 1e3
+        deg = self.degrade
+        if deg is not None:
+            # BROWNOUT+ trades tail work for good-put: tighten the
+            # caller's deadline (or impose the brownout default)
+            deadline_s = deg.tighten_deadline(deadline_s)
         now = self.clock.now()
         with self._cv:
             if self._closed:
                 raise AdmissionError("scheduler is closed")
+            if deg is not None:
+                reason = deg.shed_reason(priority)
+                if reason is not None:
+                    self.registry.count(
+                        obs_metrics.METRIC_SCHED_REJECTED,
+                        priority=priority, reason=reason)
+                    raise deg.shed(
+                        priority,
+                        retry_after_s=self._retry_after_locked(
+                            len(self._queue)))
             limit = self.max_queue
             if priority == PRIORITY_BATCH:
                 # batch traffic may only fill half the queue, reserving
@@ -239,9 +258,12 @@ class QueryScheduler:
                                   priority=priority, reason="queue_full")
                 raise AdmissionError(
                     f"admission queue full ({len(self._queue)} queued, "
-                    f"limit {limit} for priority={priority})")
-            if self.adaptive_window:
-                self._observe_arrival(now)
+                    f"limit {limit} for priority={priority})",
+                    retry_after_s=self._retry_after_locked(
+                        len(self._queue)))
+            # gap EWMA feeds both the adaptive window and the
+            # Retry-After drain estimate, so observe unconditionally
+            self._observe_arrival(now)
             pending = _Pending(
                 index, query, shards, priority,
                 now + deadline_s if deadline_s > 0 else None, now, self._seq)
@@ -274,7 +296,9 @@ class QueryScheduler:
             return None  # unknown index etc.: surface at dispatch
         if key is None:
             return None  # executor counts the bypass at dispatch
-        hit, value = cache.lookup(key, count_miss=False)
+        hit, value = cache.lookup(
+            key, count_miss=False,
+            allow_stale=not getattr(self.executor, "remote", False))
         if not hit:
             return None
         fut: Future = Future()
@@ -324,6 +348,17 @@ class QueryScheduler:
         with self._cv:
             if self._closed:
                 raise AdmissionError("scheduler is closed")
+            deg = self.degrade
+            if deg is not None:
+                reason = deg.shed_reason(priority)
+                if reason is not None:
+                    self.registry.count(
+                        obs_metrics.METRIC_SCHED_REJECTED,
+                        priority=priority, reason=reason)
+                    raise deg.shed(
+                        priority,
+                        retry_after_s=self._retry_after_locked(
+                            self._inflight_admits + len(self._queue)))
             limit = self.max_queue
             if priority == PRIORITY_BATCH:
                 limit = max(1, self.max_queue // 2)
@@ -332,13 +367,17 @@ class QueryScheduler:
                         obs_metrics.METRIC_SCHED_REJECTED,
                         priority=priority, reason="interactive_busy")
                     raise AdmissionError(
-                        "interactive work active: batch admission yields")
+                        "interactive work active: batch admission yields",
+                        retry_after_s=self._retry_after_locked(
+                            self._inflight_admits + len(self._queue)))
             if self._inflight_admits + len(self._queue) >= limit:
                 self.registry.count(obs_metrics.METRIC_SCHED_REJECTED,
                                   priority=priority, reason="admit_full")
                 raise AdmissionError(
                     f"admission limit reached ({self._inflight_admits} "
-                    f"inflight, limit {limit} for priority={priority})")
+                    f"inflight, limit {limit} for priority={priority})",
+                    retry_after_s=self._retry_after_locked(
+                        self._inflight_admits + len(self._queue)))
             self._inflight_admits += 1
             if priority == PRIORITY_INTERACTIVE:
                 self._inflight_interactive += 1
@@ -388,6 +427,31 @@ class QueryScheduler:
     def _observe_arrival(self, now: float) -> None:
         """EWMA of inter-arrival gaps (locked; called from submit)."""
         self._arrival.observe(now)
+
+    #: Retry-After clamp: never tell a client "now", never park it for
+    #: more than 30 s on one hint
+    RETRY_AFTER_MIN_S = 0.05
+    RETRY_AFTER_MAX_S = 30.0
+
+    def _retry_after_locked(self, backlog: int) -> float:
+        """Honest Retry-After for an admission shed: the live arrival
+        window's drain estimate for the current backlog (the time that
+        backlog took to accumulate), clamped; 1.0 s until any gap has
+        been observed (a cold scheduler has no live signal yet)."""
+        drain = self._arrival.drain_s(backlog)
+        if drain is None:
+            return 1.0
+        return min(max(drain, self.RETRY_AFTER_MIN_S),
+                   self.RETRY_AFTER_MAX_S)
+
+    def retry_after_s(self, backlog: Optional[int] = None) -> float:
+        """Public drain-estimate read (used by stream backpressure and
+        the degrade probe); computes over the current queue when no
+        backlog is given."""
+        with self._lock:
+            if backlog is None:
+                backlog = self._inflight_admits + len(self._queue)
+            return self._retry_after_locked(backlog)
 
     def _window_s(self) -> float:
         """Effective batching window; policy shared with the cluster leg

@@ -51,6 +51,17 @@ class ArrivalWindow:
         else:
             self._gap_ewma += self.EWMA_ALPHA * (gap - self._gap_ewma)
 
+    def drain_s(self, backlog: int) -> Optional[float]:
+        """Estimated seconds for ``backlog`` queued arrivals to clear,
+        from the live gap EWMA: under sustained overload service pace
+        roughly tracks arrival pace, so the honest back-off is the time
+        the backlog took to accumulate (backlog * gap). None until an
+        arrival gap has been observed."""
+        gap = self._gap_ewma
+        if gap is None:
+            return None
+        return max(1, int(backlog)) * gap
+
     def window_s(self) -> float:
         """Effective batching window right now. Non-adaptive returns the
         fixed window; adaptive scales with the observed arrival rate and

@@ -157,6 +157,9 @@ _ROUTES = [
     ("POST", re.compile(r"^/index/([^/]+)/stream/push$"), "post_stream_push"),
     ("GET", re.compile(r"^/internal/stats/stream$"), "get_stats_stream"),
     ("GET", re.compile(r"^/internal/slo$"), "get_slo"),
+    # graceful-degradation ladder (sched/degrade.py): current level,
+    # transition count, last signal snapshot
+    ("GET", re.compile(r"^/internal/degrade$"), "get_internal_degrade"),
     # tenant attribution plane (obs/tenants.py): per-tenant usage,
     # quota state, fair-share weights — every tracked tenant, not just
     # the top-K that get metric labels
@@ -494,6 +497,9 @@ class Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlsplit
 
         qs = parse_qs(urlsplit(self.path).query)
+        cache = getattr(self.api, "cache", None)
+        if cache is not None:
+            cache.take_stale_flag()  # clear any untagged leftover
         if qs.get("profile", [""])[-1].lower() == "true":
             # same span-tree surface as /index/{i}/query?profile=true
             from pilosa_tpu.obs.tracing import get_tracer
@@ -502,9 +508,15 @@ class Handler(BaseHTTPRequestHandler):
                 res = self.api.sql(text, parsed=parsed)
             out = res.to_json()
             out["profile"] = root.to_json()
+            if cache is not None and cache.take_stale_flag():
+                out["stale"] = True
             self._send(200, out)
             return
-        self._send(200, self.api.sql(text, parsed=parsed).to_json())
+        out = self.api.sql(text, parsed=parsed).to_json()
+        if cache is not None and cache.take_stale_flag():
+            # brownout: SELECT served past its version fingerprint
+            out["stale"] = True
+        self._send(200, out)
 
     def _authorize_sql(self, text: str):
         """SQL statements escalate by kind, checked against the SPECIFIC
@@ -600,8 +612,19 @@ class Handler(BaseHTTPRequestHandler):
         self.api.delete_dataframe(index)
         self._send(200, {"success": True})
 
+    def _degrade_shed_import(self, b: dict) -> None:
+        """Ladder gate for the bulk-import ingress: SHED_BATCH and above
+        refuse the whole request before any apply (429 + Retry-After);
+        replica fan-out legs (``remote``) were already admitted at the
+        entry node and pass through."""
+        if not b.get("remote"):
+            shed = getattr(self.api, "_degrade_shed_batch", None)
+            if shed is not None:
+                shed()
+
     def post_import(self, index: str):
         b = self._json_body()
+        self._degrade_shed_import(b)
         self._charge_tenant_ingest(len(b.get("cols") or []), b)
         peer = self._gossip_apply(b)
         n = self.api.import_bits(
@@ -634,6 +657,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def post_import_values(self, index: str):
         b = self._json_body()
+        self._degrade_shed_import(b)
         self._charge_tenant_ingest(len(b.get("cols") or []), b)
         peer = self._gossip_apply(b)
         n = self.api.import_values(
@@ -763,6 +787,13 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, {"enabled": False})
             return
         self._send(200, {"enabled": True, **reg.stats_json()})
+
+    def get_internal_degrade(self):
+        deg = getattr(self.api, "degrade", None)
+        if deg is None:
+            self._send(200, {"enabled": False})
+            return
+        self._send(200, deg.probe())
 
     def get_stats_kernels(self):
         # the devprof registry is process-global (not hung off the

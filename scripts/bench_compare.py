@@ -164,6 +164,20 @@ def _selftest(threshold: float) -> int:
         "c21_compress_resident_rows (cpu)":
             {"metric": "c21_compress_resident_rows (cpu)", "value": 15.0,
              "unit": "x", "vs_baseline": 15.0},
+        # the soak gate (config 22) emits standing-load good-put, an
+        # intended-send-time p99 (coordinated-omission-free, so it
+        # gates the whole backlog, not just served requests), and the
+        # SLO burn headroom ratio — a DROP in headroom means standing
+        # load crept toward the shed edge even if nothing shed yet
+        "c22_soak_goodput (cpu)":
+            {"metric": "c22_soak_goodput (cpu)", "value": 4.0,
+             "unit": "ops/s", "vs_baseline": 4.0},
+        "c22_soak_p99_intended (cpu)":
+            {"metric": "c22_soak_p99_intended (cpu)", "value": 400.0,
+             "unit": "ms", "vs_baseline": 400.0},
+        "c22_soak_burn_headroom (cpu)":
+            {"metric": "c22_soak_burn_headroom (cpu)", "value": 2.0,
+             "unit": "x", "vs_baseline": 2.0},
     }
     same = compare(base, base, threshold)
     assert same and not any(r["regressed"] for r in same), \
@@ -175,12 +189,18 @@ def _selftest(threshold: float) -> int:
     slow["c19_dax_fresh_node_read_p99 (cpu)"]["value"] = 48.0  # ms up 20%
     slow["c20_pallas_parity (cpu)"]["value"] = 4.0    # families down 33%
     slow["c21_compress_resident_rows (cpu)"]["value"] = 10.0  # x down 33%
+    slow["c22_soak_goodput (cpu)"]["value"] = 3.0     # ops/s down 25%
+    slow["c22_soak_p99_intended (cpu)"]["value"] = 520.0  # ms up 30%
+    slow["c22_soak_burn_headroom (cpu)"]["value"] = 1.5   # x down 25%
     rows = compare(base, slow, threshold)
     bad = {r["metric"] for r in rows if r["regressed"]}
     assert bad == {"c13_resident_warm_p50", "c1_ingest",
                    "c19_dax_fresh_node_read_p99",
                    "c20_pallas_parity",
-                   "c21_compress_resident_rows"}, bad
+                   "c21_compress_resident_rows",
+                   "c22_soak_goodput",
+                   "c22_soak_p99_intended",
+                   "c22_soak_burn_headroom"}, bad
     # a 10% drift stays under the default 15% gate
     drift = {k: dict(v) for k, v in base.items()}
     drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0

@@ -288,6 +288,40 @@ echo "== compressed residency bench gate (bench.py --configs 21) =="
 # no worse than the dense scan on sparse rows.
 JAX_PLATFORMS=cpu python bench.py --configs 21 || exit $?
 
+echo "== degrade lane (PILOSA_TPU_DEGRADE=1) =="
+# The graceful-degradation controller bootstraps on every API in these
+# suites (default edges, so a healthy test workload never escalates):
+# results must stay bit-identical with the ladder armed, and the
+# dedicated suites prove hysteresis, shed ordering, brownout stale
+# tagging, and the DEGRADE=0 zero-cost contract.
+PILOSA_TPU_DEGRADE=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_degrade.py tests/test_sched.py \
+    tests/test_cache.py tests/test_health.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly || exit $?
+
+echo "== soak smoke lane (PILOSA_TPU_FAULT_SEED=1 / 7) =="
+# The open-loop driver's deterministic twin + bounded-table churn audit
+# must hold for ANY fault seed (seeds steer only prob-gated chaos
+# rules); two fixed seeds keep the replayed schedules reproducible
+# while exercising two distinct interleavings.
+for seed in 1 7; do
+    PILOSA_TPU_FAULT_SEED=$seed JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_loadgen.py tests/test_bounded.py \
+        tests/test_degrade.py -q -p no:cacheprovider \
+        -p no:xdist -p no:randomly || exit $?
+done
+
+echo "== standing-load soak bench gate (bench.py --configs 22) =="
+# Hard-asserts the ISSUE 19 acceptance bar in-process: a CI-scaled
+# open-loop soak against a 3-node cluster with chaos + membership churn
+# keeps SLO burn bounded and loses zero acked writes (bit-identical to
+# the oracle after heal); a 2.4x overload ramp then engages the ladder
+# in order (batch shed before interactive), serves stale-tagged
+# brownout reads, keeps good-put above half the pre-overload rate, and
+# recovers to NORMAL — with every bounded table at its cap and zero
+# metric movement while the plane was disabled.
+JAX_PLATFORMS=cpu python bench.py --configs 22 || exit $?
+
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver
 # wrappers when present. CI gates fatally against a pinned baseline.
