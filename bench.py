@@ -2828,6 +2828,122 @@ def bench_config22(device: str) -> None:
                             or any(r.stale for r in ramp_reps)))
 
 
+def bench_config23(device: str) -> None:
+    """Star Schema Benchmark over the bitwise semi-join plane.
+
+    Loads a seeded SSB dataset (lineorder + date/customer/supplier/
+    part) and runs all 13 queries Q1.1-Q4.3 three ways, gating each:
+
+    1. single node, semi-join plane ON: every query bit-identical to
+       the independent numpy oracle (HARD assert, row multisets plus
+       ORDER BY key order),
+    2. 3-node LocalCluster under a seeded FaultPlan: same 13 queries,
+       same bit-identity gate — dim bitmap broadcast + fan-out legs
+       must not change a single row,
+    3. semi-join vs PILOSA_TPU_SEMIJOIN=0 (the hash-join fallback,
+       i.e. the materialized-loop baseline) on the Q2/Q3 flights:
+       HARD assert p50 semi <= p50 hash / 2 (the >=2x claim),
+    4. zero extra cost when no JOIN: a single-table aggregate must not
+       touch the join plane at all (sql_join_* counters frozen).
+    """
+    import statistics
+    import tempfile
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.cluster.resilience import FaultPlan
+    from pilosa_tpu.loadgen import ssb
+    from pilosa_tpu.obs import metrics as M
+
+    # 15k lineorder rows is the smallest scale where host-side hash-join
+    # work dominates fixed per-query cost (below it the >=2x comparison
+    # measures planner overhead, not the join strategies)
+    data = ssb.generate(max(_n(120_000), 15_000), seed=7)
+    fault_seed = int(os.environ.get("PILOSA_TPU_FAULT_SEED", "23"))
+
+    # -- 1. single node: all 13 queries vs the oracle -------------------
+    api = API()
+    t0 = time.perf_counter()
+    ssb.load(lambda q: api.sql(q), data)
+    load_s = time.perf_counter() - t0
+    oracles = {}
+    for qid, q in ssb.QUERIES.items():
+        oracles[qid] = ssb.oracle(data, qid)
+        err = ssb.verify(data, qid, api.sql(q).data,
+                         expected=oracles[qid])
+        assert err is None, f"single-node {err}"
+
+    # -- 4. zero extra cost when no JOIN --------------------------------
+    def _join_counters():
+        c = M.REGISTRY.snapshot()["counters"]
+        return tuple(c.get(k, 0) for k in
+                     ("sql_join_queries_total", "sql_join_fallback_total"))
+
+    before = _join_counters()
+    api.sql("SELECT d_year, COUNT(*) FROM ssb_date GROUP BY d_year")
+    api.sql("SELECT SUM(lo_revenue) FROM lineorder WHERE lo_discount = 3")
+    assert _join_counters() == before, \
+        "no-JOIN queries touched the join plane"
+
+    # -- 3. semi-join vs hash-fallback p50 on Q2/Q3 ---------------------
+    flights = [q for q in ssb.QUERIES if q.startswith(("Q2", "Q3"))]
+
+    def _p50(qid):
+        times = []
+        for _ in range(QUERY_ITERS):
+            t0 = time.perf_counter()
+            api.sql(ssb.QUERIES[qid])
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times) * 1e3
+
+    semi_p50, hash_p50 = {}, {}
+    for qid in flights:
+        api.sql(ssb.QUERIES[qid])  # warm compile caches
+        semi_p50[qid] = _p50(qid)
+    os.environ["PILOSA_TPU_SEMIJOIN"] = "0"
+    try:
+        for qid in flights:
+            api.sql(ssb.QUERIES[qid])
+            hash_p50[qid] = _p50(qid)
+    finally:
+        del os.environ["PILOSA_TPU_SEMIJOIN"]
+    speedups = {q: hash_p50[q] / max(semi_p50[q], 1e-6) for q in flights}
+    worst = min(speedups, key=speedups.get)
+    assert speedups[worst] >= 2.0, (
+        f"semi-join p50 speedup on {worst} is {speedups[worst]:.2f}x "
+        f"(semi={semi_p50[worst]:.2f}ms hash={hash_p50[worst]:.2f}ms), "
+        "want >=2x on every Q2/Q3 flight")
+
+    # -- 2. 3-node cluster under faults: same bit-identity gate ---------
+    plan = FaultPlan(seed=fault_seed)
+    with tempfile.TemporaryDirectory(prefix="bench23") as tmp, \
+            LocalCluster(3, replica_n=2, base_path=tmp,
+                         fault_plan=plan) as cluster:
+        coord = cluster.coordinator
+        ssb.load(lambda q: coord.sql(q), data)
+        for qid, q in ssb.QUERIES.items():
+            err = ssb.verify(data, qid, coord.sql(q).data,
+                             expected=oracles[qid])
+            assert err is None, f"3-node {err}"
+
+    snap = M.REGISTRY.snapshot()["counters"]
+    _emit(f"c23_ssb_q21_semi_p50{SCALED} ({device})",
+          float(semi_p50["Q2.1"]), "ms", float(semi_p50["Q2.1"]),
+          hash_p50_ms=hash_p50["Q2.1"], rows=len(data.lineorder["_id"]),
+          load_s=load_s)
+    _emit(f"c23_ssb_q31_semi_p50{SCALED} ({device})",
+          float(semi_p50["Q3.1"]), "ms", float(semi_p50["Q3.1"]),
+          hash_p50_ms=hash_p50["Q3.1"])
+    _emit(f"c23_ssb_semi_speedup{SCALED} ({device})",
+          float(speedups[worst]), "x", float(speedups[worst]),
+          worst_flight=worst, queries_verified=len(ssb.QUERIES),
+          cluster_verified=True, fault_seed=fault_seed,
+          join_queries=int(snap.get("sql_join_queries_total", 0)),
+          join_fallbacks=int(snap.get("sql_join_fallback_total", 0)),
+          broadcast_bytes=int(
+              snap.get("sql_join_broadcast_bytes_total", 0)))
+
+
 _CONFIGS = {
     "1": bench_config1,
     "2": bench_config2,
@@ -2850,6 +2966,7 @@ _CONFIGS = {
     "20": bench_config20,
     "21": bench_config21,
     "22": bench_config22,
+    "23": bench_config23,
     "3": bench_config3,  # headline LAST so its line is what the driver parses
 }
 

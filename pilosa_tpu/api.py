@@ -239,6 +239,9 @@ class API:
         self._tenants_fair = (True if config is None
                               else bool(config.tenants_fair_share))
         reg = self.tenants = TenantRegistry.from_config(config, **overrides)
+        if config is not None:
+            # [tenants.<id>] stanzas: per-tenant quota/weight overrides
+            reg.apply_overrides(getattr(config, "tenants_overrides", None))
         reg.install_hooks()
         self._wire_tenants()
         return reg
@@ -255,6 +258,7 @@ class API:
             self.cache.tenant_hook = reg.cache_hook
             self.cache.tenant_of = current_tenant_id
             self.cache.tenant_quota_bytes = reg.cache_quota_bytes
+            self.cache.tenant_quota_of = reg.cache_quota_for
         if self.scheduler is not None and getattr(self, "_tenants_fair",
                                                   True):
             self.scheduler.set_fair_share(True, reg.weight)
@@ -270,6 +274,7 @@ class API:
             self.cache.tenant_hook = None
             self.cache.tenant_of = None
             self.cache.tenant_quota_bytes = 0
+            self.cache.tenant_quota_of = None
         if self.scheduler is not None:
             self.scheduler.set_fair_share(False)
 

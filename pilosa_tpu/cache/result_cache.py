@@ -121,6 +121,10 @@ class ResultCache:
         self.tenant_hook = None
         self.tenant_of = None
         self.tenant_quota_bytes = 0
+        # per-tenant override resolver ([tenants.<id>] cache-bytes
+        # stanzas): tenant -> byte quota, falling back to
+        # tenant_quota_bytes when unset
+        self.tenant_quota_of = None
         self._tenant_bytes: Dict[str, int] = {}
         # brownout stale serving (sched/degrade.py, wired by
         # API.enable_degrade): the version fingerprint is the LAST key
@@ -256,10 +260,12 @@ class ResultCache:
         expires = (now + self.ttl_ms / 1000.0
                    if self.ttl_ms > 0 else float("inf"))
         stored = copy.deepcopy(value)
+        quota = (self.tenant_quota_of(tenant)
+                 if self.tenant_quota_of is not None
+                 else self.tenant_quota_bytes)
         with self._lock:
-            if (tenant is not None and self.tenant_quota_bytes > 0
-                    and self._tenant_bytes.get(tenant, 0) + cost
-                    > self.tenant_quota_bytes
+            if (tenant is not None and quota > 0
+                    and self._tenant_bytes.get(tenant, 0) + cost > quota
                     and key not in self._entries):
                 # over-quota tenants recompute instead of displacing the
                 # others' working set; serving stays correct, just uncached

@@ -567,6 +567,15 @@ class ClusterExecutor:
             fname = args.get("_field") or args.get("field")
             args["previous"] = self._field_key(idx, fname, args["previous"],
                                                False)
+        if call.name == "Rows" and isinstance(args.get("in"), (list, tuple)):
+            # semi-join broadcast lists ship pre-translated ints from the
+            # coordinator; stray string members resolve here so remote
+            # legs never see untranslated keys
+            fname = args.get("_field") or args.get("field")
+            args["in"] = [
+                self._field_key(idx, fname, v, False)
+                if isinstance(v, str) else v
+                for v in args["in"]]
         # Call-valued args (GroupBy filter=/aggregate=) recurse too.
         for k, v in args.items():
             if isinstance(v, Call):

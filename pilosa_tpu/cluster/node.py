@@ -412,6 +412,7 @@ class ClusterNode:
             cache.tenant_hook = reg.cache_hook
             cache.tenant_of = current_tenant_id
             cache.tenant_quota_bytes = reg.cache_quota_bytes
+            cache.tenant_quota_of = reg.cache_quota_for
         sched = self.executor.scheduler
         if sched is not None and getattr(self.api, "_tenants_fair", True):
             sched.set_fair_share(True, reg.weight)

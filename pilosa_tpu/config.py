@@ -246,6 +246,11 @@ class Config:
     tenants_default_ingest_rows_s: float = 0.0  # rows/s per tenant
     tenants_cache_quota_bytes: int = 0  # resident cache bytes per tenant
     tenants_fair_share: bool = True  # weighted-fair admission ordering
+    # [tenants.<id>] stanzas: per-tenant quota/weight overrides applied
+    # at enable_tenants time. Recognized keys per stanza: qps,
+    # ingest-rows-s, cache-bytes, weight.
+    tenants_overrides: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
     # elastic serverless plane ([dax] section / PILOSA_TPU_DAX_*): the
     # disaggregated deployment shape (dax/) — group-commit shared-FS
@@ -338,6 +343,21 @@ class Config:
         # flatten to cluster_resilience_*
         flat: Dict[str, Any] = {}
 
+        # [tenants.<id>] stanzas are per-tenant override MAPS, not
+        # scalar config fields — lift them out before flattening (real
+        # tomllib nests them under "tenants"; the subset parser keeps
+        # the dotted header as a flat "tenants.<id>" key)
+        overrides: Dict[str, Dict[str, Any]] = {}
+        tsec = doc.get("tenants")
+        if isinstance(tsec, dict):
+            for k in [k for k, v in tsec.items() if isinstance(v, dict)]:
+                overrides[k] = {ik.replace("-", "_"): iv
+                                for ik, iv in tsec.pop(k).items()}
+        for k in [k for k in doc if k.startswith("tenants.")
+                  and isinstance(doc[k], dict)]:
+            overrides[k[len("tenants."):]] = {
+                ik.replace("-", "_"): iv for ik, iv in doc.pop(k).items()}
+
         def _flatten(prefix: str, d: Dict[str, Any]) -> None:
             for k, v in d.items():
                 key = (f"{prefix}_{k}" if prefix else k) \
@@ -354,6 +374,8 @@ class Config:
         for k in list(flat):
             if k.startswith("obs_tracing_"):
                 flat["trace_" + k[len("obs_tracing_"):]] = flat.pop(k)
+        if overrides:
+            flat["tenants_overrides"] = overrides
         return flat
 
     @classmethod
@@ -369,15 +391,25 @@ class Config:
 
     def to_toml(self) -> str:
         lines = ["# pilosa-tpu configuration (all keys optional)"]
+
+        def scalar(v) -> str:
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, (int, float)):
+                return str(v)
+            if isinstance(v, list):
+                return "[" + ", ".join(f'"{x}"' for x in v) + "]"
+            return f'"{v}"'
+
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
-            if isinstance(v, bool):
-                tv = "true" if v else "false"
-            elif isinstance(v, (int, float)):
-                tv = str(v)
-            elif isinstance(v, list):
-                tv = "[" + ", ".join(f'"{x}"' for x in v) + "]"
-            else:
-                tv = f'"{v}"'
-            lines.append(f"{f.name.replace('_', '-')} = {tv}")
+            if isinstance(v, dict):
+                continue  # emitted as [section.id] stanzas below
+            lines.append(f"{f.name.replace('_', '-')} = {scalar(v)}")
+        # per-tenant stanzas last: a TOML table header scopes every key
+        # after it, so they must follow all top-level keys
+        for tid, kv in sorted(self.tenants_overrides.items()):
+            lines.append(f"\n[tenants.{tid}]")
+            for k, v in sorted(kv.items()):
+                lines.append(f"{k.replace('_', '-')} = {scalar(v)}")
         return "\n".join(lines) + "\n"

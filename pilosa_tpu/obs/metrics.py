@@ -40,6 +40,17 @@ METRIC_MESH_FALLBACK = "mesh_sharding_fallback_total"
 # rows received from peers by SQL subtree fanout (transfer accounting:
 # asserts reduced streams, not whole tables, cross the wire)
 METRIC_SQL_FANOUT_ROWS = "sql_fanout_rows_total"
+# bitwise semi-join plane (sql/joins.py): star joins planned as
+# dimension-bitmap broadcasts into one masked fact dispatch
+METRIC_SQL_JOIN_QUERIES = "sql_join_queries_total"  # semi-join planned
+# star joins that fell back to the host hash join (unsupported shape or
+# PILOSA_TPU_SEMIJOIN=0)
+METRIC_SQL_JOIN_FALLBACK = "sql_join_fallback_total"
+# dimension row ids broadcast as fact-side filters (per dim leg)
+METRIC_SQL_JOIN_DIM_ROWS = "sql_join_dim_rows_total"
+# approximate serialized bytes of the broadcast in= lists (what a
+# cluster fan-out leg carries on the wire per dimension)
+METRIC_SQL_JOIN_BROADCAST_BYTES = "sql_join_broadcast_bytes_total"
 # query scheduler (sched/): micro-batching health
 METRIC_SCHED_QUEUE_DEPTH = "sched_queue_depth"
 METRIC_SCHED_INFLIGHT = "sched_inflight"

@@ -260,7 +260,8 @@ class TenantRegistry:
     # -- quotas ------------------------------------------------------------
 
     def set_quota(self, tenant: str, qps: Optional[float] = None,
-                  ingest_rows_s: Optional[float] = None) -> None:
+                  ingest_rows_s: Optional[float] = None,
+                  cache_bytes: Optional[int] = None) -> None:
         """Per-tenant overrides; drops any existing bucket so the new
         rate takes effect on the next charge."""
         with self._lock:
@@ -271,6 +272,27 @@ class TenantRegistry:
             if ingest_rows_s is not None:
                 q["ingest_rows_s"] = float(ingest_rows_s)
                 self._ingest.pop(tenant, None)
+            if cache_bytes is not None:
+                q["cache_bytes"] = int(cache_bytes)
+
+    def cache_quota_for(self, tenant: Optional[str]) -> int:
+        """Resident-cache byte quota for ``tenant``: its [tenants.<id>]
+        override when set, else the registry-wide default (0 = no
+        cap). The result cache consults this per insert."""
+        with self._lock:
+            q = self._quotas.get(tenant or DEFAULT_TENANT, {})
+            return int(q.get("cache_bytes", self.cache_quota_bytes))
+
+    def apply_overrides(self, overrides) -> None:
+        """Install ``[tenants.<id>]`` config stanzas (config.py
+        tenants_overrides): per-tenant qps / ingest-rows-s /
+        cache-bytes quotas and fair-share weight."""
+        for tid, kv in (overrides or {}).items():
+            self.set_quota(tid, qps=kv.get("qps"),
+                           ingest_rows_s=kv.get("ingest_rows_s"),
+                           cache_bytes=kv.get("cache_bytes"))
+            if kv.get("weight") is not None:
+                self.set_weight(tid, kv["weight"])
 
     def set_weight(self, tenant: str, weight: float) -> None:
         with self._lock:

@@ -628,6 +628,10 @@ class Executor:
                     raise PQLError("UnionRows children must be Rows calls")
                 field = idx.field(self._field_name(c))
                 from_a, to_a = c.arg("from"), c.arg("to")
+                in_a = c.arg("in")
+                restricted = (c.arg("limit") is not None
+                              or c.arg("previous") is not None
+                              or c.arg("column") is not None)
                 if from_a is not None or to_a is not None:
                     # records with ANY matching event in the range: OR of
                     # the selected row planes across the covering quantum
@@ -636,24 +640,30 @@ class Executor:
                     views = field.range_views(
                         _parse_ts(from_a) if from_a is not None else None,
                         _parse_ts(to_a) if to_a is not None else None)
-                    restricted = (c.arg("limit") is not None
-                                  or c.arg("previous") is not None
-                                  or c.arg("column") is not None)
                     # _rows_list honors from/to together with the
-                    # limit/previous/column options
-                    rows = (self._rows_list(idx, c, shard_list, mask)
-                            if restricted else None)
+                    # in/limit/previous/column options; a bare in= list
+                    # needs no device trip at all
+                    if restricted:
+                        rows = self._rows_list(idx, c, shard_list, mask)
+                    elif in_a is not None:
+                        rows = self._in_row_ids(field, in_a)
+                    else:
+                        rows = None
                     for v in views:
                         st = stacked_set(field, shard_list, v)
                         sel = st.row_ids if rows is None else rows
                         out = B.plane_or(out, st.rows_plane(sel))
                     continue
                 st = stacked_set(field, shard_list, timeq.VIEW_STANDARD)
-                if (c.arg("limit") is None and c.arg("previous") is None
-                        and c.arg("column") is None):
-                    rows = st.row_ids  # empty rows OR in nothing
-                else:
+                if restricted:
                     rows = self._rows_list(idx, c, shard_list, mask)
+                elif in_a is not None:
+                    # explicit row selection (the SQL semi-join broadcast:
+                    # dimension row ids OR'd into one fact-side filter) —
+                    # pure host list, rows_plane skips ids with no plane
+                    rows = self._in_row_ids(field, in_a)
+                else:
+                    rows = st.row_ids  # empty rows OR in nothing
                 out = B.plane_or(out, st.rows_plane(rows))
             return out
         if name == "Shift":
@@ -943,6 +953,27 @@ class Executor:
             raise PQLError(f"{call.name} requires a field")
         return fname
 
+    def _in_row_ids(self, field: Field, values) -> List[int]:
+        """Resolve a ``Rows(f, in=[...])`` selection to row ids. String
+        members go through the field translator in one batch; unknown
+        keys drop out (an absent dimension member matches no rows — the
+        same silence as ``Row(f="missing")`` returning empty)."""
+        strs = [v for v in values if isinstance(v, str)]
+        if strs and not field.options.keys:
+            raise PQLError(f"field {field.name!r} does not use string keys")
+        found = field.translate.find_keys(strs) if strs else {}
+        out = set()
+        for v in values:
+            if isinstance(v, str):
+                r = found.get(v)
+                if r is not None:
+                    out.add(r)
+            elif isinstance(v, bool):
+                out.add(1 if v else 0)
+            else:
+                out.add(int(v))
+        return sorted(out)
+
     def _rows_list(self, idx: Index, call: Call, shards=None,
                    mask: Optional[ShardMask] = None) -> List[int]:
         field = idx.field(self._field_name(call))
@@ -975,6 +1006,10 @@ class Executor:
                 rows = {row for slot, row in enumerate(row_ids)
                         if counts[slot]}
         out = sorted(rows)
+        in_a = call.arg("in")
+        if in_a is not None:
+            want = set(self._in_row_ids(field, in_a))
+            out = [r for r in out if r in want]
         prev = call.arg("previous")
         if prev is not None:
             prev_id = self._row_id(field, prev)

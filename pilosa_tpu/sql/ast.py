@@ -89,6 +89,19 @@ class FuncCall(Expr):
     distinct: bool = False  # COUNT(DISTINCT col)
 
 
+@dataclasses.dataclass
+class PQLFilter(Expr):
+    """A pre-lowered PQL bitmap predicate carried as WHERE conjunct
+    (planner-internal, never produced by the parser). The semi-join
+    planner (sql/joins.py) rewrites star joins into single-table fact
+    selects whose WHERE carries the broadcast dimension bitmaps as
+    PQLFilter nodes; lower_filter parses the text back to a Call, so
+    the whole single-table pipeline — aggregate fusion, fanout,
+    order/limit pushdown — applies unchanged. Stored as PQL text (not a
+    Call) so dataclass repr/equality stay cheap and wire-safe."""
+    pql: str
+
+
 # -- statements --------------------------------------------------------------
 
 @dataclasses.dataclass

@@ -177,20 +177,29 @@ class SQLEngine:
         """Result-cache key for a plain single-table SELECT, or None.
         The key is the normalized SQL text + the table's full fragment
         version fingerprint (a SELECT may touch any field/shard of its
-        table, so the whole table is the conservative read set). Views,
-        joins, derived tables and system tables pass through uncached —
-        their read sets span other objects."""
-        if not sql or not stmt.table or stmt.derived or stmt.joins:
+        table, so the whole table is the conservative read set). A star
+        join keys on EVERY joined table's fingerprint — a dimension
+        write must invalidate the joined result even though the fact
+        table is untouched. Views, derived tables and system tables
+        pass through uncached — their read sets span other objects."""
+        if not sql or not stmt.table or stmt.derived:
             return None
-        if stmt.table in _SYSTEM_TABLES or stmt.table in self.views:
+        names = [stmt.table] + [j.table for j in stmt.joins]
+        if any(n in _SYSTEM_TABLES or n in self.views for n in names):
             return None
-        idx = self.api.holder.indexes.get(stmt.table)
-        if idx is None:
-            return None  # let planning raise the usual unknown-table error
-        shard_list = sorted(idx.shards())
-        return ("sql", " ".join(sql.split()), stmt.table,
-                cache_keys.shard_key(shard_list),
-                cache_keys.version_fingerprint(idx, shard_list))
+        parts = []
+        for n in names:
+            idx = self.api.holder.indexes.get(n)
+            if idx is None:
+                return None  # let planning raise unknown-table as usual
+            shard_list = sorted(idx.shards())
+            parts.append((n, cache_keys.shard_key(shard_list),
+                          cache_keys.version_fingerprint(idx, shard_list)))
+        if not stmt.joins:
+            # historical single-table key shape, unchanged
+            n, sk, fp = parts[0]
+            return ("sql", " ".join(sql.split()), n, sk, fp)
+        return ("sql", " ".join(sql.split()), tuple(parts))
 
     def _create_function(self, cf: ast.CreateFunction) -> SQLResult:
         name = cf.name.lower()  # function names are case-insensitive

@@ -24,6 +24,9 @@ KEYWORDS = {
     "MIN", "MAX", "TIMEUNIT", "TIMEQUANTUM", "TTL", "CACHETYPE", "SIZE",
     "COMMENT", "KEYPARTITIONS", "EXTRACT", "CAST",
     "JOIN", "INNER", "LEFT", "OUTER", "ON", "VIEW",
+    # recognized so unsupported join kinds error clearly instead of
+    # parsing the kind word as a table alias of an INNER join
+    "RIGHT", "FULL", "CROSS",
     "FUNCTION", "RETURNS", "BEGIN", "END", "MODEL", "PREDICT", "USING",
     "COPY", "TO", "URL", "APIKEY", "LANGUAGE",
 }

@@ -125,7 +125,12 @@ class Parser:
             elif self.peek().kind == "IDENT":
                 s.table_alias = self.ident()
             # left-deep JOIN chain (reference: sql3/parser source joins)
-            while self.at_kw("JOIN", "INNER", "LEFT"):
+            while self.at_kw("JOIN", "INNER", "LEFT", "RIGHT", "FULL",
+                             "CROSS"):
+                if self.at_kw("RIGHT", "FULL", "CROSS"):
+                    raise SQLError(
+                        f"{self.peek().value} JOIN is not supported "
+                        "(INNER and LEFT joins only)")
                 kind = "INNER"
                 if self.accept_kw("LEFT"):
                     self.accept_kw("OUTER")
@@ -638,6 +643,7 @@ _SOFT_KEYWORDS = frozenset({
     "MIN", "MAX", "COMMENT", "SIZE", "TOP",
     "URL", "APIKEY", "MODEL", "FUNCTION", "LANGUAGE", "RETURNS",
     "BEGIN", "END", "COPY", "TO", "PREDICT", "USING",
+    "RIGHT", "FULL", "CROSS",
 })
 
 

@@ -330,6 +330,11 @@ class Planner:
         return fc, hp
 
     def lower_filter(self, idx: Index, e: ast.Expr) -> Call:
+        if isinstance(e, ast.PQLFilter):
+            # planner-internal semi-join broadcast (sql/joins.py): the
+            # bitmap predicate is already PQL text
+            from pilosa_tpu.pql.parser import parse as _pql_parse
+            return _pql_parse(e.pql).calls[0]
         if isinstance(e, ast.Binary):
             if e.op == "AND":
                 return Call("Intersect", children=[
@@ -540,7 +545,7 @@ class Planner:
             return self._plan_groupby(idx, s, items, aggs, ctx)
         # no GROUP BY: single output row, each aggregate is one kernel query
         filter_call, host_pred = self._split_filter(idx, s.where)
-        if host_pred is not None:
+        if host_pred is not None or not all(_agg_kernel_ok(a) for a in aggs):
             return self._plan_host_aggregate(idx, s, items, aggs, ctx)
         executor = self._read_executor()
         agg_names = self._name_aggs(aggs, ctx)
@@ -897,8 +902,29 @@ class Planner:
         where = qualify(s.where) if s.where is not None else None
         group_by = [qualify(g) for g in s.group_by]
         having = qualify(s.having) if s.having is not None else None
-        order_by = [ast.OrderTerm(qualify(t.expr), t.desc)
+        out_names = {self._item_name(it, i) for i, it in enumerate(items)}
+
+        def qualify_order(e: ast.Expr) -> ast.Expr:
+            # a bare ref naming a projected output sorts by that output
+            # column (alias precedence, as in the single-table path)
+            if isinstance(e, ast.ColumnRef) and e.table is None \
+                    and e.name in out_names:
+                return e
+            return qualify(e)
+
+        order_by = [ast.OrderTerm(qualify_order(t.expr), t.desc)
                     for t in s.order_by]
+
+        # bitwise semi-join plane (sql/joins.py): star shapes — INNER
+        # joins over `fact.fk = dim._id` — compile to dimension bitmap
+        # broadcasts plus ONE masked fact dispatch; shapes the rewriter
+        # can't prove safe fall back to the host hash join below
+        from pilosa_tpu.sql import joins as _joins
+
+        semi = _joins.try_semi_join(self, s, tables, idxs, items, ons,
+                                    where, group_by, having, order_by)
+        if semi is not None:
+            return semi
 
         # split WHERE: single-table conjuncts that LOWER to PQL push into
         # that table's scan (below the join); everything else — multi-
@@ -935,7 +961,8 @@ class Planner:
                   ([having] if having is not None else []) +
                   [t.expr for t in order_by] + residual):
             for r in _qualified_refs(e):
-                need[r.table].add(r.name)
+                if r.table in need:  # bare refs are output-alias sorts
+                    need[r.table].add(r.name)
         for a, preds in host_push.items():
             for c in preds:  # unqualified: columns of this table only
                 need[a] |= _columns_of(c)
@@ -978,6 +1005,18 @@ class Planner:
             seen.add(a)
         for c in residual:
             op = plan.FilterOp(op, _to_keys(c))
+        return self._finish_join_plan(op, s, idxs, aliases, items,
+                                      group_by, having, order_by)
+
+    def _finish_join_plan(self, op: PlanOp, s: ast.SelectStatement,
+                          idxs: Dict[str, Index], aliases: List[str],
+                          items: List[ast.SelectItem],
+                          group_by: List[ast.Expr],
+                          having: Optional[ast.Expr],
+                          order_by: List[ast.OrderTerm]) -> PlanOp:
+        """Shared tail of every join strategy (hash join and semi-join
+        decorated scans): host aggregation/projection over the qualified
+        'alias.col' stream, then order/distinct/limit."""
 
         def jtype(e: ast.Expr) -> str:
             if isinstance(e, ast.ColumnRef) and e.table in idxs:
@@ -1355,6 +1394,12 @@ def _rewrite_aggs(e: ast.Expr, names: Dict[str, str]) -> ast.Expr:
     if isinstance(e, ast.Unary):
         return ast.Unary(e.op, _rewrite_aggs(e.operand, names))
     return e
+
+
+def _agg_kernel_ok(a: ast.FuncCall) -> bool:
+    """One aggregate -> one PQL kernel call needs a plain column (or *)
+    argument; expression aggregates (SUM(a*b)) evaluate host-side."""
+    return not a.args or isinstance(a.args[0], (ast.ColumnRef, ast.Star))
 
 
 def _agg_col(a: ast.FuncCall) -> str:

@@ -178,6 +178,16 @@ def _selftest(threshold: float) -> int:
         "c22_soak_burn_headroom (cpu)":
             {"metric": "c22_soak_burn_headroom (cpu)", "value": 2.0,
              "unit": "x", "vs_baseline": 2.0},
+        # the star-schema gate (config 23) emits semi-join p50s (ms,
+        # up = regress) and the worst Q2/Q3 speedup vs the hash
+        # fallback (x, down = regress — a drop means the semi plane
+        # stopped paying for itself on some flight)
+        "c23_ssb_q21_semi_p50 (cpu)":
+            {"metric": "c23_ssb_q21_semi_p50 (cpu)", "value": 60.0,
+             "unit": "ms", "vs_baseline": 60.0},
+        "c23_ssb_semi_speedup (cpu)":
+            {"metric": "c23_ssb_semi_speedup (cpu)", "value": 3.0,
+             "unit": "x", "vs_baseline": 3.0},
     }
     same = compare(base, base, threshold)
     assert same and not any(r["regressed"] for r in same), \
@@ -192,6 +202,8 @@ def _selftest(threshold: float) -> int:
     slow["c22_soak_goodput (cpu)"]["value"] = 3.0     # ops/s down 25%
     slow["c22_soak_p99_intended (cpu)"]["value"] = 520.0  # ms up 30%
     slow["c22_soak_burn_headroom (cpu)"]["value"] = 1.5   # x down 25%
+    slow["c23_ssb_q21_semi_p50 (cpu)"]["value"] = 78.0    # ms up 30%
+    slow["c23_ssb_semi_speedup (cpu)"]["value"] = 2.2     # x down 27%
     rows = compare(base, slow, threshold)
     bad = {r["metric"] for r in rows if r["regressed"]}
     assert bad == {"c13_resident_warm_p50", "c1_ingest",
@@ -200,7 +212,9 @@ def _selftest(threshold: float) -> int:
                    "c21_compress_resident_rows",
                    "c22_soak_goodput",
                    "c22_soak_p99_intended",
-                   "c22_soak_burn_headroom"}, bad
+                   "c22_soak_burn_headroom",
+                   "c23_ssb_q21_semi_p50",
+                   "c23_ssb_semi_speedup"}, bad
     # a 10% drift stays under the default 15% gate
     drift = {k: dict(v) for k, v in base.items()}
     drift["c13_resident_warm_p50 (cpu)"]["value"] = 11.0

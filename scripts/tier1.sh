@@ -322,6 +322,23 @@ echo "== standing-load soak bench gate (bench.py --configs 22) =="
 # metric movement while the plane was disabled.
 JAX_PLATFORMS=cpu python bench.py --configs 22 || exit $?
 
+echo "== ssb smoke lane (tiny-scale flights vs numpy oracle) =="
+# One query per SSB flight (Q1.1/Q2.1/Q3.1/Q4.1) at tiny scale must be
+# bit-identical to the independent numpy oracle on BOTH the semi-join
+# plane and the PILOSA_TPU_SEMIJOIN=0 hash fallback, plus the JOIN
+# grammar battery and the semi-join plane's own test file.
+JAX_PLATFORMS=cpu python -m pytest tests/test_ssb.py \
+    tests/test_sql_parser.py tests/test_sql_joins.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
+echo "== star schema bench gate (bench.py --configs 23) =="
+# Hard-asserts the ISSUE 20 acceptance bar in-process: all 13 SSB
+# queries bit-identical to the oracle single-node AND on a 3-node
+# cluster under a seeded FaultPlan; p50 semi-join >=2x faster than the
+# hash fallback on every Q2/Q3 flight; no-JOIN queries leave every
+# sql_join_* counter untouched.
+JAX_PLATFORMS=cpu python bench.py --configs 23 || exit $?
+
 echo "== bench regression report (scripts/bench_compare.py --latest) =="
 # Non-fatal report step: diffs the two most recent BENCH_r*.json driver
 # wrappers when present. CI gates fatally against a pinned baseline.

@@ -58,6 +58,35 @@ class TestConfig:
         p.write_text(text)
         assert Config.from_sources(toml_path=str(p)) == Config()
 
+    def test_tenant_stanzas(self, tmp_path):
+        toml = tmp_path / "t.toml"
+        toml.write_text(
+            "port = 7000\n"
+            "[tenants.alpha]\nqps = 50\ncache-bytes = 4096\nweight = 3.0\n"
+            "[tenants.beta]\ningest-rows-s = 1000\n")
+        cfg = Config.from_sources(toml_path=str(toml))
+        assert cfg.tenants_overrides == {
+            "alpha": {"qps": 50, "cache_bytes": 4096, "weight": 3.0},
+            "beta": {"ingest_rows_s": 1000}}
+        # per-tenant stanzas survive to_toml -> from_sources
+        p = tmp_path / "gen.toml"
+        p.write_text(cfg.to_toml())
+        assert Config.from_sources(toml_path=str(p)) == cfg
+
+    def test_tenant_stanzas_applied_at_enable(self, tmp_path):
+        toml = tmp_path / "t.toml"
+        toml.write_text(
+            "[tenants.alpha]\nqps = 50\ncache-bytes = 4096\nweight = 3.0\n")
+        cfg = Config.from_sources(toml_path=str(toml))
+        api = API()
+        api.enable_cache()
+        api.enable_tenants(config=cfg)
+        reg = api.tenants
+        assert reg.cache_quota_for("alpha") == 4096
+        # unconfigured tenants fall back to the global default
+        assert reg.cache_quota_for("nobody") == reg.cache_quota_bytes
+        assert api.cache.tenant_quota_of("alpha") == 4096
+
 
 class TestBackupRestore:
     def test_tar_roundtrip_between_servers(self, server):

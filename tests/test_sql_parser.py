@@ -1,0 +1,92 @@
+"""SQL parser grammar battery for the JOIN surface (reference:
+sql3/parser — this engine recognizes INNER and LEFT joins with a
+single-conjunct ON and errors clearly on everything else, it never
+silently misparses an unsupported join)."""
+
+import pytest
+
+from pilosa_tpu.sql import ast
+from pilosa_tpu.sql.lexer import SQLError
+from pilosa_tpu.sql.parser import parse_statement
+
+
+def _sel(sql):
+    s = parse_statement(sql)
+    assert isinstance(s, ast.SelectStatement)
+    return s
+
+
+class TestJoinGrammar:
+    def test_bare_join_is_inner(self):
+        s = _sel("SELECT a FROM f JOIN d ON f.k = d._id")
+        assert len(s.joins) == 1
+        j = s.joins[0]
+        assert (j.table, j.kind) == ("d", "INNER")
+        assert isinstance(j.on, ast.Binary) and j.on.op == "="
+
+    def test_inner_keyword(self):
+        s = _sel("SELECT a FROM f INNER JOIN d ON f.k = d._id")
+        assert s.joins[0].kind == "INNER"
+
+    def test_left_and_left_outer(self):
+        for kw in ("LEFT JOIN", "LEFT OUTER JOIN"):
+            s = _sel(f"SELECT a FROM f {kw} d ON f.k = d._id")
+            assert s.joins[0].kind == "LEFT"
+
+    def test_aliases_as_and_bare(self):
+        s = _sel("SELECT x.a FROM fact AS x JOIN dim y ON x.k = y._id")
+        assert s.table_alias == "x"
+        assert s.joins[0].alias == "y"
+
+    def test_qualified_on_columns(self):
+        s = _sel("SELECT f.a FROM fact f JOIN dim d ON f.fk = d._id")
+        on = s.joins[0].on
+        assert (on.left.table, on.left.name) == ("f", "fk")
+        assert (on.right.table, on.right.name) == ("d", "_id")
+
+    def test_reversed_on_order(self):
+        # dim._id = fact.fk parses the same shape; direction is the
+        # planner's problem, not the parser's
+        s = _sel("SELECT f.a FROM fact f JOIN dim d ON d._id = f.fk")
+        on = s.joins[0].on
+        assert (on.left.table, on.left.name) == ("d", "_id")
+
+    def test_multi_join_chain(self):
+        s = _sel(
+            "SELECT f.a FROM fact f "
+            "JOIN d1 ON f.k1 = d1._id "
+            "LEFT JOIN d2 ON f.k2 = d2._id "
+            "JOIN d3 x ON f.k3 = x._id")
+        assert [(j.table, j.kind) for j in s.joins] == [
+            ("d1", "INNER"), ("d2", "LEFT"), ("d3", "INNER")]
+        assert s.joins[2].alias == "x"
+
+    def test_join_with_tail_clauses(self):
+        s = _sel(
+            "SELECT d.y, SUM(f.v) AS r FROM fact f "
+            "JOIN dim d ON f.k = d._id WHERE d.z = 3 "
+            "GROUP BY d.y HAVING SUM(f.v) > 0 "
+            "ORDER BY r DESC LIMIT 5")
+        assert len(s.joins) == 1 and s.limit == 5
+        assert s.order_by[0].desc
+
+    @pytest.mark.parametrize("kind", ["RIGHT", "FULL", "CROSS"])
+    def test_unsupported_kinds_error_clearly(self, kind):
+        with pytest.raises(SQLError, match=f"{kind} JOIN is not supported"):
+            parse_statement(
+                f"SELECT a FROM f {kind} JOIN d ON f.k = d._id")
+
+    def test_unsupported_kind_not_eaten_as_alias(self):
+        # before RIGHT/FULL/CROSS were keywords this parsed as table
+        # alias "RIGHT" + INNER join — silent wrong semantics
+        with pytest.raises(SQLError):
+            parse_statement("SELECT a FROM f RIGHT JOIN d ON f.k = d._id")
+
+    def test_soft_keywords_stay_usable_as_columns(self):
+        s = _sel("SELECT right, full, cross FROM f WHERE right = 1")
+        assert [it.expr.name for it in s.items] == [
+            "right", "full", "cross"]
+
+    def test_join_requires_on(self):
+        with pytest.raises(SQLError):
+            parse_statement("SELECT a FROM f JOIN d WHERE a = 1")

@@ -1,0 +1,53 @@
+"""Star Schema Benchmark smoke: the tier-1 lane runs one query per
+flight at tiny scale against the independent numpy oracle, on both the
+semi-join plane and the hash fallback. The full 13-query battery
+(plus 3-node cluster + faults + the >=2x p50 gate) lives in
+``bench.py --configs 23``."""
+
+import os
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.loadgen import ssb
+from pilosa_tpu.sql import SQLEngine
+
+SMOKE_FLIGHTS = ["Q1.1", "Q2.1", "Q3.1", "Q4.1"]
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    data = ssb.generate("tiny", seed=7)
+    eng = SQLEngine(API())
+    ssb.load(lambda q: eng.query(q), data)
+    return data, eng
+
+
+class TestSSBSmoke:
+    @pytest.mark.parametrize("qid", SMOKE_FLIGHTS)
+    def test_flight_vs_oracle(self, loaded, qid):
+        data, eng = loaded
+        got = eng.query(ssb.QUERIES[qid]).data
+        assert ssb.verify(data, qid, got) is None
+        os.environ["PILOSA_TPU_SEMIJOIN"] = "0"
+        try:
+            hashed = eng.query(ssb.QUERIES[qid]).data
+        finally:
+            del os.environ["PILOSA_TPU_SEMIJOIN"]
+        assert got == hashed
+
+    def test_all_queries_parse_and_plan(self, loaded):
+        _, eng = loaded
+        for qid, q in ssb.QUERIES.items():
+            eng.query(q)  # no SQLError on any of the 13
+
+    def test_datagen_deterministic(self):
+        a = ssb.generate("tiny", seed=7)
+        b = ssb.generate("tiny", seed=7)
+        assert (a.lineorder["lo_revenue"] == b.lineorder["lo_revenue"]).all()
+        assert a.part["p_brand1"] == b.part["p_brand1"]
+
+    def test_full_battery(self, loaded):
+        data, eng = loaded
+        for qid, q in ssb.QUERIES.items():
+            assert ssb.verify(data, qid, eng.query(q).data) is None
